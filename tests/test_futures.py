@@ -1,0 +1,109 @@
+"""Tests for the Future/Work primitives and the timeout engine.
+
+Mirrors the reference's futures_test.py coverage: timeout fire/cancel,
+context timeouts, future chaining, error propagation.
+"""
+
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.futures import context_timeout, future_timeout, future_wait
+from torchft_tpu.work import DummyWork, Future
+
+
+class TestFuture:
+    def test_set_result_and_wait(self):
+        f = Future()
+        f.set_result(42)
+        assert f.done()
+        assert f.wait() == 42
+        assert f.value() == 42
+
+    def test_exception_propagates(self):
+        f = Future()
+        f.set_exception(RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="boom"):
+            f.wait()
+
+    def test_wait_timeout(self):
+        f = Future()
+        with pytest.raises(TimeoutError):
+            f.wait(timeout=0.05)
+
+    def test_then_chains_value(self):
+        f = Future()
+        g = f.then(lambda fut: fut.value() + 1)
+        f.set_result(1)
+        assert g.wait() == 2
+
+    def test_then_chains_exception(self):
+        f = Future()
+        g = f.then(lambda fut: fut.value() + 1)
+        f.set_exception(ValueError("nope"))
+        with pytest.raises(ValueError):
+            g.wait()
+
+    def test_then_after_completion(self):
+        f = Future.completed(10)
+        g = f.then(lambda fut: fut.value() * 2)
+        assert g.wait() == 20
+
+    def test_cross_thread_wait(self):
+        f = Future()
+
+        def worker():
+            time.sleep(0.02)
+            f.set_result("ok")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        assert f.wait(timeout=5) == "ok"
+        t.join()
+
+
+class TestDummyWork:
+    def test_completed(self):
+        w = DummyWork([1, 2, 3])
+        assert w.wait()
+        assert w.get_future().value() == [1, 2, 3]
+        assert w.exception() is None
+
+
+class TestTimeoutEngine:
+    def test_future_timeout_fires(self):
+        f = Future()
+        wrapped = future_timeout(f, 0.05)
+        with pytest.raises(TimeoutError):
+            wrapped.wait(timeout=5)
+
+    def test_future_timeout_cancelled_on_completion(self):
+        f = Future()
+        wrapped = future_timeout(f, 5.0)
+        f.set_result(7)
+        assert wrapped.wait(timeout=5) == 7
+
+    def test_future_timeout_propagates_error(self):
+        f = Future()
+        wrapped = future_timeout(f, 5.0)
+        f.set_exception(RuntimeError("inner"))
+        with pytest.raises(RuntimeError, match="inner"):
+            wrapped.wait(timeout=5)
+
+    def test_future_wait(self):
+        f = Future.completed(3)
+        assert future_wait(f, 1.0) == 3
+
+    def test_context_timeout_fires_callback(self):
+        fired = threading.Event()
+        with context_timeout(fired.set, 0.05):
+            time.sleep(0.3)
+        assert fired.is_set()
+
+    def test_context_timeout_cancelled(self):
+        fired = threading.Event()
+        with context_timeout(fired.set, 0.5):
+            pass
+        time.sleep(0.7)
+        assert not fired.is_set()

@@ -1,0 +1,89 @@
+"""RWLock tests (reference pattern: torchft checkpointing rwlock_test)."""
+
+import threading
+import time
+
+import pytest
+
+from torchft_tpu.checkpointing import RWLock
+
+
+def test_multiple_readers():
+    lock = RWLock()
+    assert lock.r_acquire()
+    assert lock.r_acquire()
+    assert lock.r_locked()
+    lock.r_release()
+    lock.r_release()
+    assert not lock.r_locked()
+
+
+def test_writer_excludes_readers():
+    lock = RWLock()
+    assert lock.w_acquire()
+    assert lock.w_locked()
+    assert not lock.r_acquire(timeout=0.05)
+    lock.w_release()
+    assert lock.r_acquire(timeout=0.05)
+    lock.r_release()
+
+
+def test_reader_excludes_writer():
+    lock = RWLock()
+    with lock.r_lock():
+        assert not lock.w_acquire(timeout=0.05)
+    assert lock.w_acquire(timeout=0.05)
+    lock.w_release()
+
+
+def test_read_preference_nested_reads():
+    """Overlapping/nested reads succeed even while a writer waits.
+
+    Matches the reference contract: checkpoint-send holds the read lock while
+    state-dict callbacks re-enter it (torchft/checkpointing/_rwlock.py).
+    """
+    lock = RWLock()
+    lock.r_acquire()
+    got_write = threading.Event()
+
+    def writer():
+        lock.w_acquire()
+        got_write.set()
+        lock.w_release()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.05)  # writer is now waiting on the held read lock
+    assert lock.r_acquire(timeout=0.5), "nested read must not deadlock"
+    lock.r_release()
+    lock.r_release()
+    assert got_write.wait(timeout=2)
+    t.join()
+    with lock.r_lock(timeout=1):
+        pass
+
+
+def test_writer_timeout_does_not_wedge_readers():
+    lock = RWLock()
+    with lock.r_lock():
+        assert not lock.w_acquire(timeout=0.05)
+        assert lock.r_acquire(timeout=0.5)
+        lock.r_release()
+    with lock.w_lock(timeout=1):
+        pass
+
+
+def test_context_managers_raise_on_timeout():
+    lock = RWLock()
+    lock.w_acquire()
+    with pytest.raises(TimeoutError):
+        with lock.r_lock(timeout=0.05):
+            pass
+    lock.w_release()
+
+
+def test_default_timeout():
+    lock = RWLock(timeout=0.05)
+    lock.w_acquire()
+    assert not lock.w_acquire()
+    lock.w_release()

@@ -1,0 +1,10 @@
+"""torchft_tpu: TPU-native per-step fault tolerance for data-parallel training.
+
+A from-scratch JAX/XLA framework with the capabilities of torchft
+(reference: /root/reference): lighthouse quorum control plane (C++),
+reconfigurable collective communicators, error-swallowing managed allreduce,
+two-phase commit, live peer-to-peer checkpoint recovery, and fault-tolerant
+DDP / HSDP / LocalSGD / DiLoCo training algorithms.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from torchft_tpu.checkpointing._rwlock import RWLock
+
+__all__ = ["RWLock"]

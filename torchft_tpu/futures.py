@@ -1,0 +1,213 @@
+"""Timeout engine for futures and blocking contexts.
+
+Design follows the reference's ``torchft/futures.py:27-354``: a singleton
+manager owning a background asyncio event loop thread that arms timers for
+
+- ``future_timeout(fut, timeout)`` — returns a future that raises
+  ``TimeoutError`` if the inner one does not complete in time,
+- ``future_wait(fut, timeout)`` — blocking wait with timeout,
+- ``context_timeout(callback, timeout)`` — context manager invoking
+  ``callback`` (typically ``pg.abort``) if the block does not exit in time,
+
+plus a watchdog thread that hard-exits the process if the event loop itself
+wedges (reference: torchft/futures.py:102-125, ``TORCHFT_WATCHDOG_TIMEOUT_SEC``).
+There is no stream_timeout equivalent: JAX has no user streams; device-side
+completion is observed via ``jax.Array.block_until_ready`` on a worker thread
+instead (see ``process_group_xla``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import threading
+from contextlib import contextmanager
+from datetime import timedelta
+from typing import Any, Callable, Generator, Optional, TypeVar
+
+from torchft_tpu.work import Future
+
+T = TypeVar("T")
+
+WATCHDOG_TIMEOUT_SEC = float(os.environ.get("TORCHFT_WATCHDOG_TIMEOUT_SEC", 30.0))
+
+__all__ = ["future_timeout", "future_wait", "context_timeout", "stop_timeout_manager"]
+
+
+def _to_seconds(timeout: "float | timedelta") -> float:
+    if isinstance(timeout, timedelta):
+        return timeout.total_seconds()
+    return float(timeout)
+
+
+class _TimerHandle:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._cancelled = False
+
+    def set_timer_handle(self, handle: asyncio.TimerHandle) -> None:
+        with self._lock:
+            if self._cancelled:
+                handle.cancel()
+                self._handle = None
+            else:
+                self._handle = handle
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._cancelled = True
+            if self._handle is not None:
+                self._handle.cancel()
+                self._handle = None
+
+
+class _TimeoutManager:
+    """Singleton owning the timer event loop + watchdog."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Per-generation shutdown signal: a restart after shutdown() creates a
+        # fresh Event, so a lingering watchdog from the previous generation
+        # only ever observes its own.
+        self._shutdown_evt: Optional[threading.Event] = None
+
+    def _maybe_start(self) -> asyncio.AbstractEventLoop:
+        with self._lock:
+            if self._loop is None:
+                loop = asyncio.new_event_loop()
+                thread = threading.Thread(
+                    target=loop.run_forever, daemon=True, name="torchft_timeout_loop"
+                )
+                thread.start()
+                self._loop = loop
+                shutdown_evt = threading.Event()
+                self._shutdown_evt = shutdown_evt
+                threading.Thread(
+                    target=self._watchdog_loop,
+                    args=(loop, shutdown_evt),
+                    daemon=True,
+                    name="torchft_watchdog",
+                ).start()
+            return self._loop
+
+    def _watchdog_loop(
+        self, loop: asyncio.AbstractEventLoop, shutdown_evt: threading.Event
+    ) -> None:
+        # Periodically schedule a no-op on the event loop; if it fails to run
+        # within the watchdog budget the loop is wedged (a timer callback is
+        # stuck, likely inside an abort) — kill the process rather than hang
+        # training forever. Matches reference torchft/futures.py:102-125.
+        ticked = threading.Event()
+        while not shutdown_evt.is_set():
+            ticked.clear()
+            try:
+                loop.call_soon_threadsafe(ticked.set)
+            except RuntimeError:
+                return  # loop closed
+            if not ticked.wait(WATCHDOG_TIMEOUT_SEC):
+                if shutdown_evt.is_set():
+                    return
+                print(
+                    "torchft_tpu watchdog: timeout event loop is stuck for "
+                    f"{WATCHDOG_TIMEOUT_SEC}s, exiting process",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(1)
+            # Tick at half the watchdog budget; wakes immediately on shutdown.
+            shutdown_evt.wait(WATCHDOG_TIMEOUT_SEC / 2)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if self._shutdown_evt is not None:
+                self._shutdown_evt.set()
+                self._shutdown_evt = None
+            if self._loop is not None:
+                loop = self._loop
+                self._loop = None
+                loop.call_soon_threadsafe(loop.stop)
+
+    # -- public ops -------------------------------------------------------
+    def register(self, fut: Future[T], timeout: float) -> Future[T]:
+        loop = self._maybe_start()
+        out: Future[T] = Future()
+        handle = _TimerHandle()
+
+        def _on_timeout() -> None:
+            if not out.done():
+                try:
+                    out.set_exception(
+                        TimeoutError(f"future did not complete within {timeout}s")
+                    )
+                except RuntimeError:
+                    pass
+
+        loop.call_soon_threadsafe(
+            lambda: handle.set_timer_handle(loop.call_later(timeout, _on_timeout))
+        )
+
+        def _transfer(f: Future[T]) -> None:
+            handle.cancel()
+            if out.done():
+                return
+            try:
+                exc = f.exception()
+                if exc is not None:
+                    out.set_exception(exc)
+                else:
+                    out.set_result(f.value())
+            except RuntimeError:
+                pass  # lost the race with the timeout
+
+        fut.add_done_callback(_transfer)
+        return out
+
+    def context_timeout(
+        self, callback: Callable[[], None], timeout: float
+    ) -> "Generator[None, None, None]":
+        loop = self._maybe_start()
+        handle = _TimerHandle()
+
+        @contextmanager
+        def _ctx() -> Generator[None, None, None]:
+            loop.call_soon_threadsafe(
+                lambda: handle.set_timer_handle(loop.call_later(timeout, callback))
+            )
+            try:
+                yield
+            finally:
+                handle.cancel()
+
+        return _ctx()
+
+
+_TIMEOUT_MANAGER = _TimeoutManager()
+
+
+def future_timeout(fut: Future[T], timeout: "float | timedelta") -> Future[T]:
+    """Return a future failing with TimeoutError if ``fut`` is late."""
+    return _TIMEOUT_MANAGER.register(fut, _to_seconds(timeout))
+
+
+def future_wait(fut: Future[T], timeout: "float | timedelta") -> T:
+    """Wait for ``fut`` up to ``timeout``; raises TimeoutError on expiry."""
+    return fut.wait(timeout=_to_seconds(timeout))
+
+
+def context_timeout(
+    callback: Callable[[], None], timeout: "float | timedelta"
+) -> "Generator[None, None, None]":
+    """Context manager calling ``callback`` if the block overruns ``timeout``.
+
+    Used to arm abort watchdogs around blocking collectives, mirroring the
+    reference's abort-based timeout recovery (torchft/process_group.py:739-763).
+    """
+    return _TIMEOUT_MANAGER.context_timeout(callback, _to_seconds(timeout))
+
+
+def stop_timeout_manager() -> None:
+    """Shut down the background loop (test teardown only)."""
+    _TIMEOUT_MANAGER.shutdown()

@@ -1,0 +1,225 @@
+// C API for Python ctypes bindings (torchft_tpu/coordination.py).
+// Role-equivalent of the reference's pyo3 extension module src/lib.rs:
+// server lifecycles + blocking client RPCs. ctypes releases the GIL around
+// every call, matching the reference's py.allow_threads behavior.
+//
+// Conventions: returns int status (see TFT_* codes); out-strings are
+// malloc'd and must be freed with tft_free.
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "kvstore.h"
+#include "lighthouse.h"
+#include "manager_server.h"
+#include "quorum.h"
+#include "wire.h"
+
+using namespace tft;
+
+extern "C" {
+
+enum {
+  TFT_OK = 0,
+  TFT_TIMEOUT = 1,
+  TFT_ERROR = 2,
+  TFT_NOT_FOUND = 3,
+  TFT_INVALID = 4,
+  TFT_UNAVAILABLE = 5,
+};
+
+static char* dup_str(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return out;
+}
+
+static int status_of(const RpcError& e) {
+  if (e.code == "timeout") return TFT_TIMEOUT;
+  if (e.code == "not_found") return TFT_NOT_FOUND;
+  if (e.code == "invalid") return TFT_INVALID;
+  if (e.code == "unavailable") return TFT_UNAVAILABLE;
+  return TFT_ERROR;
+}
+
+#define TFT_TRY(...)                                    \
+  try {                                                 \
+    __VA_ARGS__;                                        \
+  } catch (const RpcError& e) {                         \
+    if (err) *err = dup_str(e.what());                  \
+    return status_of(e);                                \
+  } catch (const std::exception& e) {                   \
+    if (err) *err = dup_str(e.what());                  \
+    std::string msg = e.what();                         \
+    return msg.find("timed out") != std::string::npos   \
+               ? TFT_TIMEOUT                            \
+               : TFT_ERROR;                             \
+  }
+
+void tft_free(char* p) { free(p); }
+
+// ---------------------------------------------------------------- lighthouse
+int tft_lighthouse_new(const char* bind, int64_t min_replicas,
+                       int64_t join_timeout_ms, int64_t quorum_tick_ms,
+                       int64_t heartbeat_timeout_ms, void** out, char** err) {
+  TFT_TRY({
+    LighthouseOpts opts;
+    opts.min_replicas = min_replicas;
+    opts.join_timeout_ms = join_timeout_ms;
+    opts.quorum_tick_ms = quorum_tick_ms;
+    opts.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    *out = new Lighthouse(bind, opts);
+    return TFT_OK;
+  })
+}
+
+char* tft_lighthouse_address(void* h) {
+  return dup_str(static_cast<Lighthouse*>(h)->address());
+}
+int tft_lighthouse_port(void* h) { return static_cast<Lighthouse*>(h)->port(); }
+void tft_lighthouse_shutdown(void* h) {
+  static_cast<Lighthouse*>(h)->shutdown();
+}
+void tft_lighthouse_free(void* h) { delete static_cast<Lighthouse*>(h); }
+
+// ------------------------------------------------------------------- manager
+int tft_manager_new(const char* opts_json, void** out, char** err) {
+  TFT_TRY({
+    Json j = Json::parse(opts_json);
+    ManagerOpts opts;
+    opts.replica_id = j.get("replica_id").as_string();
+    opts.lighthouse_addr = j.get("lighthouse_addr").as_string();
+    opts.hostname = j.get_or("hostname", Json("")).as_string();
+    opts.bind = j.get_or("bind", Json("0.0.0.0:0")).as_string();
+    opts.store_addr = j.get_or("store_addr", Json("")).as_string();
+    opts.world_size = j.get_or("world_size", Json(int64_t{1})).as_int();
+    opts.heartbeat_interval_ms =
+        j.get_or("heartbeat_interval_ms", Json(int64_t{100})).as_int();
+    opts.connect_timeout_ms =
+        j.get_or("connect_timeout_ms", Json(int64_t{10000})).as_int();
+    opts.quorum_retries = j.get_or("quorum_retries", Json(int64_t{0})).as_int();
+    *out = new ManagerServer(opts);
+    return TFT_OK;
+  })
+}
+
+char* tft_manager_address(void* h) {
+  return dup_str(static_cast<ManagerServer*>(h)->address());
+}
+int tft_manager_port(void* h) { return static_cast<ManagerServer*>(h)->port(); }
+void tft_manager_shutdown(void* h) {
+  static_cast<ManagerServer*>(h)->shutdown();
+}
+void tft_manager_free(void* h) { delete static_cast<ManagerServer*>(h); }
+
+// ------------------------------------------------------------------- clients
+// Client handles are {addr, connect_timeout}; each call dials fresh (see
+// RpcClient docs) so one handle is safe from many threads.
+struct ClientHandle {
+  std::string addr;
+  int64_t connect_timeout_ms;
+};
+
+int tft_client_new(const char* addr, int64_t connect_timeout_ms, void** out,
+                   char** err) {
+  TFT_TRY({
+    *out = new ClientHandle{addr, connect_timeout_ms};
+    return TFT_OK;
+  })
+}
+void tft_client_free(void* h) { delete static_cast<ClientHandle*>(h); }
+
+// Generic call: params/result as JSON strings. Used by Python for every RPC.
+int tft_client_call(void* h, const char* method, const char* params_json,
+                    int64_t timeout_ms, char** result, char** err) {
+  TFT_TRY({
+    auto* c = static_cast<ClientHandle*>(h);
+    RpcClient client(c->addr, Millis(c->connect_timeout_ms));
+    Json params = Json::parse(params_json);
+    Json r = client.call(method, params, Millis(timeout_ms));
+    if (result) *result = dup_str(r.dump());
+    return TFT_OK;
+  })
+}
+
+// ------------------------------------------------------------------- kvstore
+int tft_kvstore_new(const char* bind, void** out, char** err) {
+  TFT_TRY({
+    *out = new KvStoreServer(bind);
+    return TFT_OK;
+  })
+}
+int tft_kvstore_port(void* h) { return static_cast<KvStoreServer*>(h)->port(); }
+void tft_kvstore_shutdown(void* h) {
+  static_cast<KvStoreServer*>(h)->shutdown();
+}
+void tft_kvstore_free(void* h) { delete static_cast<KvStoreServer*>(h); }
+
+// ------------------------------------------------------- pure quorum logic
+// Exposed for unit tests (reference pattern: src/lighthouse.rs:627-1071 and
+// src/manager.rs:881-1108 test these as pure functions).
+
+// state_json: {"participants": [{"member": {...}, "joined_ms_ago": N}],
+//              "heartbeats": {"rid": age_ms}, "prev_quorum": {...}|null,
+//              "quorum_id": N}
+int tft_quorum_compute(const char* state_json, const char* opts_json,
+                       char** result, char** err) {
+  TFT_TRY({
+    Json js = Json::parse(state_json);
+    Json jo = Json::parse(opts_json);
+    LighthouseOpts opts;
+    opts.min_replicas = jo.get_or("min_replicas", Json(int64_t{1})).as_int();
+    opts.join_timeout_ms =
+        jo.get_or("join_timeout_ms", Json(int64_t{60000})).as_int();
+    opts.heartbeat_timeout_ms =
+        jo.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
+
+    TimePoint now = Clock::now();
+    LighthouseState state;
+    state.quorum_id = js.get_or("quorum_id", Json(int64_t{0})).as_int();
+    // Bind to a named value: get_or returns a temporary, and a range-for over
+    // a reference into it would dangle.
+    Json participants = js.get_or("participants", Json::array());
+    for (const auto& p : participants.as_array()) {
+      MemberDetails d;
+      d.member = QuorumMember::from_json(p.get("member"));
+      d.joined = now - Millis(p.get_or("joined_ms_ago", Json(int64_t{0})).as_int());
+      state.participants[d.member.replica_id] = d;
+    }
+    if (js.contains("heartbeats")) {
+      for (const auto& [rid, age] : js.get("heartbeats").as_object())
+        state.heartbeats[rid] = now - Millis(age.as_int());
+    }
+    if (js.contains("prev_quorum") && !js.get("prev_quorum").is_null())
+      state.prev_quorum = QuorumSnapshot::from_json(js.get("prev_quorum"));
+
+    auto [met, reason] = quorum_compute(now, state, opts);
+    Json out = Json::object();
+    out["reason"] = reason;
+    if (met) {
+      Json parts = Json::array();
+      for (const auto& m : *met) parts.push_back(m.to_json());
+      out["participants"] = parts;
+    } else {
+      out["participants"] = Json();
+    }
+    if (result) *result = dup_str(out.dump());
+    return TFT_OK;
+  })
+}
+
+int tft_compute_quorum_results(const char* replica_id, int64_t group_rank,
+                               const char* quorum_json, int init_sync,
+                               char** result, char** err) {
+  TFT_TRY({
+    QuorumSnapshot q = QuorumSnapshot::from_json(Json::parse(quorum_json));
+    ManagerQuorumResult r =
+        compute_quorum_results(replica_id, group_rank, q, init_sync != 0);
+    if (result) *result = dup_str(r.to_json().dump());
+    return TFT_OK;
+  })
+}
+
+}  // extern "C"

@@ -1,0 +1,113 @@
+// Minimal JSON value type with parse/serialize for the torchft_tpu control
+// plane wire protocol. The reference control plane speaks protobuf over gRPC
+// (reference: proto/torchft.proto); this build has no C++ gRPC toolchain, so
+// the C++ servers speak length-framed JSON over TCP instead — same message
+// semantics, different encoding.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tft {
+
+class Json;
+using JsonArray = std::vector<Json>;
+// std::map keeps object keys ordered -> deterministic serialization.
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Int), int_(v) {}
+  Json(int64_t v) : type_(Type::Int), int_(v) {}
+  Json(uint64_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(Type::Double), double_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Int || type_ == Type::Double; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool() const { check(Type::Bool); return bool_; }
+  int64_t as_int() const {
+    if (type_ == Type::Double) return static_cast<int64_t>(double_);
+    check(Type::Int);
+    return int_;
+  }
+  double as_double() const {
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    check(Type::Double);
+    return double_;
+  }
+  const std::string& as_string() const { check(Type::String); return str_; }
+  const JsonArray& as_array() const { check(Type::Array); return arr_; }
+  JsonArray& as_array() { check(Type::Array); return arr_; }
+  const JsonObject& as_object() const { check(Type::Object); return obj_; }
+  JsonObject& as_object() { check(Type::Object); return obj_; }
+
+  // Object access. operator[] inserts (object must be mutable); get() is safe.
+  Json& operator[](const std::string& key) {
+    if (type_ == Type::Null) { type_ = Type::Object; }
+    check(Type::Object);
+    return obj_[key];
+  }
+  bool contains(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+  const Json& get(const std::string& key) const {
+    check(Type::Object);
+    auto it = obj_.find(key);
+    if (it == obj_.end()) throw std::runtime_error("missing json key: " + key);
+    return it->second;
+  }
+  Json get_or(const std::string& key, Json def) const {
+    if (!contains(key)) return def;
+    return obj_.at(key);
+  }
+  void push_back(Json v) {
+    if (type_ == Type::Null) { type_ = Type::Array; }
+    check(Type::Array);
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    if (type_ == Type::Array) return arr_.size();
+    if (type_ == Type::Object) return obj_.size();
+    throw std::runtime_error("json: size() on non-container");
+  }
+
+  std::string dump() const;
+  static Json parse(const std::string& text);
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("json: wrong type access");
+  }
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace tft

@@ -1,0 +1,88 @@
+#include "kvstore.h"
+
+namespace tft {
+
+KvStoreServer::KvStoreServer(const std::string& bind) {
+  server_ = std::make_unique<RpcServer>(
+      bind, [this](const std::string& m, const Json& p, TimePoint d) {
+        return handle(m, p, d);
+      });
+}
+
+KvStoreServer::~KvStoreServer() { shutdown(); }
+
+void KvStoreServer::shutdown() {
+  bool was = running_.exchange(false);
+  if (!was) return;
+  cv_.notify_all();
+  server_->shutdown();
+}
+
+Json KvStoreServer::handle(const std::string& method, const Json& params,
+                           TimePoint deadline) {
+  if (method == "set") {
+    std::lock_guard<std::mutex> lk(mu_);
+    data_[params.get("key").as_string()] = params.get("value").as_string();
+    cv_.notify_all();
+    return Json::object();
+  }
+  if (method == "get") {
+    std::string key = params.get("key").as_string();
+    bool wait = params.get_or("wait", Json(true)).as_bool();
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!wait) {
+      auto it = data_.find(key);
+      if (it == data_.end()) throw RpcError("not_found", "key not set: " + key);
+      Json j = Json::object();
+      j["value"] = it->second;
+      return j;
+    }
+    bool got = cv_.wait_until(lk, deadline, [&] {
+      return !running_.load() || data_.count(key) > 0;
+    });
+    if (!running_.load()) throw RpcError("unavailable", "store shutting down");
+    if (!got) throw TimeoutError("get timed out waiting for key: " + key);
+    Json j = Json::object();
+    j["value"] = data_[key];
+    return j;
+  }
+  if (method == "add") {
+    std::string key = params.get("key").as_string();
+    int64_t amount = params.get("amount").as_int();
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t cur = 0;
+    auto it = data_.find(key);
+    if (it != data_.end()) cur = std::stoll(it->second);
+    cur += amount;
+    data_[key] = std::to_string(cur);
+    cv_.notify_all();
+    Json j = Json::object();
+    j["value"] = cur;
+    return j;
+  }
+  if (method == "check") {
+    std::lock_guard<std::mutex> lk(mu_);
+    bool all = true;
+    for (const auto& k : params.get("keys").as_array())
+      if (!data_.count(k.as_string())) { all = false; break; }
+    Json j = Json::object();
+    j["exists"] = all;
+    return j;
+  }
+  if (method == "delete") {
+    std::lock_guard<std::mutex> lk(mu_);
+    size_t n = data_.erase(params.get("key").as_string());
+    Json j = Json::object();
+    j["deleted"] = n > 0;
+    return j;
+  }
+  if (method == "num_keys") {
+    std::lock_guard<std::mutex> lk(mu_);
+    Json j = Json::object();
+    j["count"] = static_cast<int64_t>(data_.size());
+    return j;
+  }
+  throw RpcError("invalid", "unknown kvstore method: " + method);
+}
+
+}  // namespace tft

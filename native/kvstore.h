@@ -1,0 +1,36 @@
+// TCP key-value store for rendezvous: the TPU-native replacement for the
+// reference's PyTorch TCPStore (used for manager-address discovery,
+// manager.py:333-337, and per-quorum communicator bootstrap with prefixes
+// "{store}/torchft/{quorum_id}/{group_rank}", manager.py:703-705).
+// Blocking get with timeout + atomic add, over the framed-JSON wire protocol.
+// Values are opaque strings (clients base64-encode binary payloads).
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+
+#include "wire.h"
+
+namespace tft {
+
+class KvStoreServer {
+ public:
+  explicit KvStoreServer(const std::string& bind);
+  ~KvStoreServer();
+
+  int port() const { return server_->port(); }
+  void shutdown();
+
+ private:
+  Json handle(const std::string& method, const Json& params, TimePoint deadline);
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+  std::atomic<bool> running_{true};
+  std::unique_ptr<RpcServer> server_;
+};
+
+}  // namespace tft

@@ -1,0 +1,249 @@
+#include "lighthouse.h"
+
+#include <cstdio>
+#include <sstream>
+
+namespace tft {
+
+namespace {
+void log_info(const std::string& msg) {
+  std::fprintf(stderr, "[lighthouse] %s\n", msg.c_str());
+}
+}  // namespace
+
+Lighthouse::Lighthouse(const std::string& bind, LighthouseOpts opts)
+    : opts_(opts) {
+  server_ = std::make_unique<RpcServer>(
+      bind,
+      [this](const std::string& m, const Json& p, TimePoint d) {
+        return handle(m, p, d);
+      },
+      [this](const std::string& m, const std::string& p) {
+        return handle_http(m, p);
+      });
+  tick_thread_ = std::thread([this] { tick_loop(); });
+}
+
+Lighthouse::~Lighthouse() { shutdown(); }
+
+std::string Lighthouse::address() const {
+  return local_hostname() + ":" + std::to_string(server_->port());
+}
+
+void Lighthouse::shutdown() {
+  bool was = running_.exchange(false);
+  if (!was) return;
+  quorum_cv_.notify_all();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  server_->shutdown();
+}
+
+void Lighthouse::tick_loop() {
+  while (running_.load()) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      quorum_tick_locked();
+    }
+    std::this_thread::sleep_for(Millis(opts_.quorum_tick_ms));
+  }
+}
+
+void Lighthouse::quorum_tick_locked() {
+  // Prune long-dead heartbeat entries so replica-id churn (each restart has a
+  // fresh uuid-suffixed id) doesn't grow state without bound. Kept for 10x
+  // the timeout so the dashboard still shows recently-dead replicas.
+  auto now = Clock::now();
+  for (auto it = state_.heartbeats.begin(); it != state_.heartbeats.end();) {
+    if (now - it->second > Millis(10 * opts_.heartbeat_timeout_ms))
+      it = state_.heartbeats.erase(it);
+    else
+      ++it;
+  }
+  auto [met, reason] = quorum_compute(Clock::now(), state_, opts_);
+  if (reason != last_reason_) {
+    log_info(reason);
+    last_reason_ = reason;
+  }
+  if (!met) return;
+  auto participants = *met;
+
+  std::vector<std::string> commit_failure_ids;
+  for (const auto& p : participants)
+    if (p.commit_failures > 0) commit_failure_ids.push_back(p.replica_id);
+
+  // Bump quorum_id only when membership changed or a member reported commit
+  // failures (so a retried step gets a fresh communicator world).
+  if (!state_.prev_quorum.has_value() ||
+      quorum_changed(participants, state_.prev_quorum->participants)) {
+    state_.quorum_id += 1;
+    log_info("Detected quorum change, bumping quorum_id to " +
+             std::to_string(state_.quorum_id));
+  } else if (!commit_failure_ids.empty()) {
+    state_.quorum_id += 1;
+    std::string ids;
+    for (const auto& id : commit_failure_ids) ids += id + ",";
+    log_info("Detected commit failures in [" + ids +
+             "], bumping quorum_id to " + std::to_string(state_.quorum_id));
+  }
+
+  QuorumSnapshot q;
+  q.quorum_id = state_.quorum_id;
+  q.participants = participants;
+  q.created_ms = epoch_millis_now();
+  state_.prev_quorum = q;
+  state_.participants.clear();
+
+  latest_quorum_ = q;
+  quorum_gen_ += 1;
+  quorum_cv_.notify_all();
+}
+
+Json Lighthouse::handle(const std::string& method, const Json& params,
+                        TimePoint deadline) {
+  if (method == "quorum") return rpc_quorum(params, deadline);
+  if (method == "heartbeat") return rpc_heartbeat(params);
+  if (method == "status") return status_json();
+  throw RpcError("invalid", "unknown lighthouse method: " + method);
+}
+
+Json Lighthouse::rpc_quorum(const Json& params, TimePoint deadline) {
+  QuorumMember requester = QuorumMember::from_json(params.get("requester"));
+  log_info("Received quorum request for replica " + requester.replica_id);
+
+  std::unique_lock<std::mutex> lk(mu_);
+  // Implicit heartbeat + join.
+  state_.heartbeats[requester.replica_id] = Clock::now();
+  state_.participants[requester.replica_id] =
+      MemberDetails{Clock::now(), requester};
+  uint64_t waiting_gen = quorum_gen_;
+  // Proactive tick so a ready quorum resolves without waiting for the timer.
+  quorum_tick_locked();
+
+  // Wait for a quorum containing the requester; if one is published without
+  // it (possible when this replica joined right after a tick cleared the
+  // participant set), re-join and keep waiting (reference re-subscribe loop,
+  // src/lighthouse.rs:523-544).
+  while (true) {
+    bool got = quorum_cv_.wait_until(lk, deadline, [&] {
+      return !running_.load() || quorum_gen_ > waiting_gen;
+    });
+    if (!running_.load()) throw RpcError("unavailable", "lighthouse shutting down");
+    if (!got) throw TimeoutError("quorum request timed out");
+    waiting_gen = quorum_gen_;
+    const QuorumSnapshot& q = *latest_quorum_;
+    bool in_quorum = std::any_of(
+        q.participants.begin(), q.participants.end(),
+        [&](const QuorumMember& m) { return m.replica_id == requester.replica_id; });
+    if (in_quorum) {
+      Json out = Json::object();
+      out["quorum"] = q.to_json();
+      return out;
+    }
+    log_info("Replica " + requester.replica_id + " not in quorum, retrying");
+    state_.participants[requester.replica_id] =
+        MemberDetails{Clock::now(), requester};
+  }
+}
+
+Json Lighthouse::rpc_heartbeat(const Json& params) {
+  std::string replica_id = params.get("replica_id").as_string();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    state_.heartbeats[replica_id] = Clock::now();
+  }
+  return Json::object();
+}
+
+Json Lighthouse::status_json() {
+  std::lock_guard<std::mutex> lk(mu_);
+  Json j = Json::object();
+  j["quorum_id"] = state_.quorum_id;
+  j["prev_quorum"] =
+      state_.prev_quorum ? state_.prev_quorum->to_json() : Json();
+  Json joined = Json::array();
+  for (const auto& [rid, d] : state_.participants) joined.push_back(rid);
+  j["participants"] = joined;
+  Json beats = Json::object();
+  auto now = Clock::now();
+  for (const auto& [rid, last] : state_.heartbeats) {
+    beats[rid] = static_cast<int64_t>(
+        std::chrono::duration_cast<Millis>(now - last).count());
+  }
+  j["heartbeat_ages_ms"] = beats;
+  return j;
+}
+
+std::string Lighthouse::status_html() {
+  Json s = status_json();
+  std::ostringstream os;
+  os << "<!doctype html><html><head><title>torchft_tpu lighthouse</title>"
+     << "<style>body{font-family:monospace;margin:2em}table{border-collapse:"
+        "collapse}td,th{border:1px solid #888;padding:4px 8px}</style></head>"
+     << "<body><h1>torchft_tpu lighthouse</h1>"
+     << "<p>quorum_id: " << s.get("quorum_id").as_int() << "</p>";
+  os << "<h2>heartbeats</h2><table><tr><th>replica</th><th>age (ms)</th>"
+        "<th></th></tr>";
+  for (const auto& [rid, age] : s.get("heartbeat_ages_ms").as_object()) {
+    os << "<tr><td>" << rid << "</td><td>" << age.as_int() << "</td><td>"
+       << "<form method=post action=\"/replica/" << rid
+       << "/kill\"><button>kill</button></form></td></tr>";
+  }
+  os << "</table>";
+  if (!s.get("prev_quorum").is_null()) {
+    os << "<h2>previous quorum</h2><table><tr><th>replica</th><th>step</th>"
+          "<th>address</th></tr>";
+    for (const auto& p : s.get("prev_quorum").get("participants").as_array()) {
+      os << "<tr><td>" << p.get("replica_id").as_string() << "</td><td>"
+         << p.get("step").as_int() << "</td><td>"
+         << p.get("address").as_string() << "</td></tr>";
+    }
+    os << "</table>";
+  }
+  os << "</body></html>";
+  return os.str();
+}
+
+std::tuple<std::string, std::string, std::string> Lighthouse::handle_http(
+    const std::string& /*method*/, const std::string& path) {
+  try {
+    if (path == "/" || path == "/index.html")
+      return {"200 OK", "text/html", status_html()};
+    if (path == "/status") return {"200 OK", "application/json", status_json().dump()};
+    // POST /replica/{id}/kill — forward a Kill RPC to that replica's manager.
+    const std::string prefix = "/replica/";
+    if (path.rfind(prefix, 0) == 0 && path.size() > prefix.size()) {
+      auto rest = path.substr(prefix.size());
+      auto slash = rest.find('/');
+      if (slash != std::string::npos && rest.substr(slash) == "/kill") {
+        std::string replica_id = rest.substr(0, slash);
+        std::string addr;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (state_.prev_quorum) {
+            for (const auto& p : state_.prev_quorum->participants)
+              if (p.replica_id == replica_id) addr = p.address;
+          }
+          auto it = state_.participants.find(replica_id);
+          if (addr.empty() && it != state_.participants.end())
+            addr = it->second.member.address;
+        }
+        if (addr.empty())
+          return {"404 Not Found", "text/plain", "unknown replica " + replica_id};
+        try {
+          RpcClient client(addr, Millis(5000));
+          Json params = Json::object();
+          params["msg"] = std::string("killed from lighthouse dashboard");
+          client.call("kill", params, Millis(5000));
+        } catch (const std::exception&) {
+          // The manager exits on kill; connection errors are expected.
+        }
+        return {"200 OK", "text/plain", "killed " + replica_id};
+      }
+    }
+    return {"404 Not Found", "text/plain", "not found"};
+  } catch (const std::exception& e) {
+    return {"500 Internal Server Error", "text/plain", e.what()};
+  }
+}
+
+}  // namespace tft

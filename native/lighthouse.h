@@ -1,0 +1,56 @@
+// Lighthouse: the global quorum coordinator, one per job.
+// Equivalent of the reference's Rust Lighthouse (src/lighthouse.rs:68-413):
+// collects heartbeats and quorum requests from every replica-group manager,
+// computes quorums on a periodic tick, broadcasts results to blocked quorum
+// RPCs (with the re-subscribe loop for members missing from a quorum), and
+// serves an HTML/JSON status dashboard with per-replica kill on the same port.
+#pragma once
+
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "quorum.h"
+#include "wire.h"
+
+namespace tft {
+
+class Lighthouse {
+ public:
+  Lighthouse(const std::string& bind, LighthouseOpts opts);
+  ~Lighthouse();
+
+  int port() const { return server_->port(); }
+  std::string address() const;
+  void shutdown();
+
+ private:
+  Json handle(const std::string& method, const Json& params, TimePoint deadline);
+  std::tuple<std::string, std::string, std::string> handle_http(
+      const std::string& method, const std::string& path);
+
+  Json rpc_quorum(const Json& params, TimePoint deadline);
+  Json rpc_heartbeat(const Json& params);
+  Json status_json();
+  std::string status_html();
+
+  void tick_loop();
+  // Must hold mu_. Runs one quorum computation; publishes on success.
+  void quorum_tick_locked();
+
+  LighthouseOpts opts_;
+  std::mutex mu_;
+  std::condition_variable quorum_cv_;
+  LighthouseState state_;
+  // Broadcast channel: bump generation + store latest quorum.
+  uint64_t quorum_gen_ = 0;
+  std::optional<QuorumSnapshot> latest_quorum_;
+  std::string last_reason_;  // dedup logging (reference ChangeLogger)
+
+  std::atomic<bool> running_{true};
+  std::unique_ptr<RpcServer> server_;
+  std::thread tick_thread_;
+};
+
+}  // namespace tft

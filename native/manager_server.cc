@@ -1,0 +1,223 @@
+#include "manager_server.h"
+
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace tft {
+
+namespace {
+void log_info(const std::string& rid, const std::string& msg) {
+  std::fprintf(stderr, "[manager %s] %s\n", rid.c_str(), msg.c_str());
+}
+}  // namespace
+
+ManagerServer::ManagerServer(ManagerOpts opts) : opts_(std::move(opts)) {
+  heartbeat_client_ = std::make_unique<RpcClient>(
+      opts_.lighthouse_addr, Millis(opts_.connect_timeout_ms));
+  quorum_client_ = std::make_unique<RpcClient>(
+      opts_.lighthouse_addr, Millis(opts_.connect_timeout_ms));
+  server_ = std::make_unique<RpcServer>(
+      opts_.bind, [this](const std::string& m, const Json& p, TimePoint d) {
+        return handle(m, p, d);
+      });
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+}
+
+ManagerServer::~ManagerServer() { shutdown(); }
+
+std::string ManagerServer::address() const {
+  std::string host = opts_.hostname.empty() ? local_hostname() : opts_.hostname;
+  return host + ":" + std::to_string(server_->port());
+}
+
+void ManagerServer::shutdown() {
+  bool was = running_.exchange(false);
+  if (!was) return;
+  quorum_cv_.notify_all();
+  commit_cv_.notify_all();
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  std::vector<std::thread> workers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    workers.swap(quorum_workers_);
+  }
+  for (auto& t : workers)
+    if (t.joinable()) t.join();
+  server_->shutdown();
+}
+
+void ManagerServer::heartbeat_loop() {
+  while (running_.load()) {
+    try {
+      Json params = Json::object();
+      params["replica_id"] = opts_.replica_id;
+      heartbeat_client_->call("heartbeat", params, Millis(opts_.connect_timeout_ms));
+    } catch (const std::exception& e) {
+      log_info(opts_.replica_id,
+               std::string("failed to send heartbeat to lighthouse: ") + e.what());
+    }
+    // Sleep in small increments so shutdown() is prompt.
+    int64_t remaining = opts_.heartbeat_interval_ms;
+    while (remaining > 0 && running_.load()) {
+      int64_t step = std::min<int64_t>(remaining, 50);
+      std::this_thread::sleep_for(Millis(step));
+      remaining -= step;
+    }
+  }
+}
+
+Json ManagerServer::handle(const std::string& method, const Json& params,
+                           TimePoint deadline) {
+  if (method == "quorum") return rpc_quorum(params, deadline);
+  if (method == "checkpoint_metadata") return rpc_checkpoint_metadata(params);
+  if (method == "should_commit") return rpc_should_commit(params, deadline);
+  if (method == "kill") {
+    std::string msg = params.get_or("msg", Json("")).as_string();
+    std::fprintf(stderr, "[manager %s] got kill request: %s\n",
+                 opts_.replica_id.c_str(), msg.c_str());
+    std::fflush(stderr);
+    _exit(1);
+  }
+  throw RpcError("invalid", "unknown manager method: " + method);
+}
+
+void ManagerServer::run_lighthouse_quorum(QuorumMember member, Millis timeout) {
+  log_info(opts_.replica_id, "All workers joined - starting quorum");
+  Json params = Json::object();
+  params["requester"] = member.to_json();
+
+  std::string last_err;
+  int64_t retries = std::max<int64_t>(opts_.quorum_retries, 0);
+  for (int64_t attempt = 0; attempt <= retries; ++attempt) {
+    try {
+      Json resp = quorum_client_->call("quorum", params, timeout);
+      QuorumSnapshot q = QuorumSnapshot::from_json(resp.get("quorum"));
+      std::lock_guard<std::mutex> lk(mu_);
+      latest_quorum_ = q;
+      quorum_error_.clear();
+      quorum_gen_ += 1;
+      quorum_cv_.notify_all();
+      return;
+    } catch (const std::exception& e) {
+      last_err = e.what();
+      log_info(opts_.replica_id,
+               "lighthouse quorum failed (attempt " + std::to_string(attempt) +
+                   "): " + last_err);
+      int64_t sleep_ms = std::max<int64_t>(
+          100, std::chrono::duration_cast<Millis>(timeout).count() /
+                   std::max<int64_t>(retries + 1, 1));
+      if (attempt < retries) std::this_thread::sleep_for(Millis(sleep_ms));
+    }
+  }
+  // Unlike the reference (which leaves waiters hanging on lighthouse failure,
+  // a known TODO at src/manager.rs:229), broadcast the error so every rank's
+  // quorum call fails fast instead of timing out.
+  std::lock_guard<std::mutex> lk(mu_);
+  quorum_error_ = "lighthouse quorum failed after " +
+                  std::to_string(retries) + " retries: " + last_err;
+  quorum_gen_ += 1;
+  quorum_cv_.notify_all();
+}
+
+Json ManagerServer::rpc_quorum(const Json& params, TimePoint deadline) {
+  int64_t group_rank = params.get("group_rank").as_int();
+  int64_t step = params.get_or("step", Json(int64_t{0})).as_int();
+  bool init_sync = params.get_or("init_sync", Json(true)).as_bool();
+
+  log_info(opts_.replica_id,
+           "Start quorum for group_rank " + std::to_string(group_rank));
+
+  uint64_t waiting_gen;
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    checkpoint_metadata_[group_rank] =
+        params.get_or("checkpoint_metadata", Json("")).as_string();
+
+    QuorumMember member;
+    member.replica_id = opts_.replica_id;
+    member.address = address();
+    member.store_address = opts_.store_addr;
+    member.step = step;
+    member.world_size = opts_.world_size;
+    member.shrink_only = params.get_or("shrink_only", Json(false)).as_bool();
+    member.commit_failures =
+        params.get_or("commit_failures", Json(int64_t{0})).as_int();
+    member.data = params.get_or("data", Json("")).as_string();
+
+    participants_[group_rank] = member;
+    waiting_gen = quorum_gen_;
+
+    if (static_cast<int64_t>(participants_.size()) == opts_.world_size) {
+      participants_.clear();
+      Millis timeout(std::max<int64_t>(ms_until(deadline), 1));
+      quorum_workers_.emplace_back(
+          [this, member, timeout] { run_lighthouse_quorum(member, timeout); });
+    }
+
+    bool got = quorum_cv_.wait_until(lk, deadline, [&] {
+      return !running_.load() || quorum_gen_ > waiting_gen;
+    });
+    if (!running_.load())
+      throw RpcError("unavailable", "manager shutting down");
+    if (!got)
+      throw TimeoutError("manager quorum timed out waiting for group barrier");
+    if (!quorum_error_.empty()) throw RpcError("internal", quorum_error_);
+
+    log_info(opts_.replica_id,
+             "Finished quorum for group_rank " + std::to_string(group_rank));
+    ManagerQuorumResult r = compute_quorum_results(
+        opts_.replica_id, group_rank, *latest_quorum_, init_sync);
+    return r.to_json();
+  }
+}
+
+Json ManagerServer::rpc_checkpoint_metadata(const Json& params) {
+  int64_t rank = params.get("rank").as_int();
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = checkpoint_metadata_.find(rank);
+  if (it == checkpoint_metadata_.end())
+    throw RpcError("invalid", "rank not found");
+  Json j = Json::object();
+  j["checkpoint_metadata"] = it->second;
+  return j;
+}
+
+Json ManagerServer::rpc_should_commit(const Json& params, TimePoint deadline) {
+  int64_t group_rank = params.get("group_rank").as_int();
+  bool should_commit = params.get("should_commit").as_bool();
+
+  log_info(opts_.replica_id,
+           "should_commit request from " + std::to_string(group_rank) +
+               " should_commit=" + (should_commit ? "true" : "false"));
+
+  std::unique_lock<std::mutex> lk(mu_);
+  if (!should_commit) commit_failures_.insert(group_rank);
+  commit_votes_.insert(group_rank);
+  uint64_t waiting_gen = commit_gen_;
+
+  if (static_cast<int64_t>(commit_votes_.size()) == opts_.world_size) {
+    commit_decision_ = commit_failures_.empty();
+    log_info(opts_.replica_id,
+             std::string("should_commit completed should_commit=") +
+                 (commit_decision_ ? "true" : "false"));
+    commit_votes_.clear();
+    commit_failures_.clear();
+    commit_gen_ += 1;
+    commit_cv_.notify_all();
+  } else {
+    bool got = commit_cv_.wait_until(lk, deadline, [&] {
+      return !running_.load() || commit_gen_ > waiting_gen;
+    });
+    if (!running_.load())
+      throw RpcError("unavailable", "manager shutting down");
+    if (!got) throw TimeoutError("should_commit timed out waiting for votes");
+  }
+
+  Json j = Json::object();
+  j["should_commit"] = commit_decision_;
+  return j;
+}
+
+}  // namespace tft

@@ -1,0 +1,83 @@
+// Per-replica-group manager service (runs alongside group_rank 0).
+// Equivalent of the reference's Rust Manager (src/manager.rs:80-486):
+// aggregates the group's ranks — when all world_size ranks call quorum it
+// forwards a single request to the lighthouse (with retries) and broadcasts
+// the result; computes per-rank recovery assignments; runs the 2-phase
+// should_commit vote; stores per-rank checkpoint metadata; Kill exits the
+// process; background heartbeat loop to the lighthouse.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <thread>
+
+#include "quorum.h"
+#include "wire.h"
+
+namespace tft {
+
+struct ManagerOpts {
+  std::string replica_id;
+  std::string lighthouse_addr;
+  std::string hostname;       // advertised host for this manager
+  std::string bind;           // "host:port", port 0 = ephemeral
+  std::string store_addr;     // rendezvous KV store address for this replica
+  int64_t world_size = 1;     // ranks inside this replica group
+  int64_t heartbeat_interval_ms = 100;
+  int64_t connect_timeout_ms = 10000;
+  int64_t quorum_retries = 0;
+};
+
+class ManagerServer {
+ public:
+  explicit ManagerServer(ManagerOpts opts);
+  ~ManagerServer();
+
+  int port() const { return server_->port(); }
+  std::string address() const;
+  void shutdown();
+
+ private:
+  Json handle(const std::string& method, const Json& params, TimePoint deadline);
+  Json rpc_quorum(const Json& params, TimePoint deadline);
+  Json rpc_checkpoint_metadata(const Json& params);
+  Json rpc_should_commit(const Json& params, TimePoint deadline);
+
+  void heartbeat_loop();
+  // Runs on a detached worker when the last rank arrives.
+  void run_lighthouse_quorum(QuorumMember member, Millis timeout);
+
+  ManagerOpts opts_;
+  std::mutex mu_;
+
+  // Quorum barrier + broadcast.
+  std::condition_variable quorum_cv_;
+  std::map<int64_t, QuorumMember> participants_;
+  uint64_t quorum_gen_ = 0;
+  std::optional<QuorumSnapshot> latest_quorum_;
+  std::string quorum_error_;  // non-empty -> last round failed
+
+  // Per-rank checkpoint metadata (healing peers fetch these).
+  std::map<int64_t, std::string> checkpoint_metadata_;
+
+  // 2-phase commit vote.
+  std::condition_variable commit_cv_;
+  std::set<int64_t> commit_votes_;
+  std::set<int64_t> commit_failures_;
+  uint64_t commit_gen_ = 0;
+  bool commit_decision_ = false;
+
+  std::atomic<bool> running_{true};
+  std::unique_ptr<RpcServer> server_;
+  std::thread heartbeat_thread_;
+  std::vector<std::thread> quorum_workers_;
+  // Separate cached-connection clients so the 100ms heartbeat never queues
+  // behind a long-blocking lighthouse quorum call.
+  std::unique_ptr<RpcClient> heartbeat_client_;
+  std::unique_ptr<RpcClient> quorum_client_;
+};
+
+}  // namespace tft

@@ -1,0 +1,460 @@
+// C++ unit tests for the native control plane, mirroring the reference's
+// inline Rust tests: quorum_compute edge cases (src/lighthouse.rs:627-1071),
+// compute_quorum_results recovery math (src/manager.rs:881-1108), 2-phase
+// should_commit (src/manager.rs:656-702), and gRPC-style e2e with in-process
+// servers (src/manager.rs:976-1020).
+
+#include <cassert>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+#include "kvstore.h"
+#include "lighthouse.h"
+#include "manager_server.h"
+#include "quorum.h"
+#include "wire.h"
+
+using namespace tft;
+
+static int failures = 0;
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++failures;                                                          \
+    }                                                                      \
+  } while (0)
+
+static QuorumMember member(const std::string& id, int64_t step = 0) {
+  QuorumMember m;
+  m.replica_id = id;
+  m.address = "addr_" + id;
+  m.store_address = "store_" + id;
+  m.step = step;
+  m.world_size = 1;
+  return m;
+}
+
+// ---------------------------------------------------------- quorum_compute
+static void test_quorum_fast_path() {
+  LighthouseOpts opts;
+  opts.min_replicas = 1;
+  opts.join_timeout_ms = 60000;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+
+  LighthouseState state;
+  state.participants["a"] = {now, member("a")};
+  state.heartbeats["a"] = now;
+  QuorumSnapshot prev;
+  prev.quorum_id = 1;
+  prev.participants = {member("a")};
+  state.prev_quorum = prev;
+
+  auto [met, reason] = quorum_compute(now, state, opts);
+  CHECK(met.has_value());
+  CHECK(reason.find("Fast quorum") != std::string::npos);
+}
+
+static void test_quorum_join_timeout_straggler() {
+  LighthouseOpts opts;
+  opts.min_replicas = 1;
+  opts.join_timeout_ms = 60000;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+
+  // "c" is heartbeating (alive) but has not joined the quorum -> wait for it
+  // (majority 2/3 is satisfied, so the straggler gate is what blocks).
+  LighthouseState state;
+  state.participants["a"] = {now, member("a")};
+  state.participants["b"] = {now, member("b")};
+  state.heartbeats["a"] = now;
+  state.heartbeats["b"] = now;
+  state.heartbeats["c"] = now;
+
+  auto [met, reason] = quorum_compute(now, state, opts);
+  CHECK(!met.has_value());
+  CHECK(reason.find("straggler") != std::string::npos);
+
+  // After the join timeout expires the quorum shrinks to the joined members.
+  state.participants["a"].joined = now - Millis(70000);
+  auto [met2, reason2] = quorum_compute(now, state, opts);
+  CHECK(met2.has_value());
+  CHECK(met2->size() == 2);
+}
+
+static void test_quorum_min_replicas() {
+  LighthouseOpts opts;
+  opts.min_replicas = 2;
+  opts.join_timeout_ms = 0;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+
+  LighthouseState state;
+  state.participants["a"] = {now, member("a")};
+  state.heartbeats["a"] = now;
+  auto [met, reason] = quorum_compute(now, state, opts);
+  CHECK(!met.has_value());
+  CHECK(reason.find("min_replicas") != std::string::npos);
+
+  state.participants["b"] = {now, member("b")};
+  state.heartbeats["b"] = now;
+  auto [met2, _] = quorum_compute(now, state, opts);
+  CHECK(met2.has_value());
+  CHECK(met2->size() == 2);
+}
+
+static void test_quorum_expired_heartbeat() {
+  LighthouseOpts opts;
+  opts.min_replicas = 1;
+  opts.join_timeout_ms = 0;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+
+  LighthouseState state;
+  state.participants["a"] = {now, member("a")};
+  state.participants["b"] = {now, member("b")};
+  state.heartbeats["a"] = now;
+  state.heartbeats["b"] = now - Millis(10000);  // expired
+
+  auto [met, _] = quorum_compute(now, state, opts);
+  CHECK(met.has_value());
+  CHECK(met->size() == 1);
+  CHECK((*met)[0].replica_id == "a");
+}
+
+static void test_quorum_split_brain() {
+  LighthouseOpts opts;
+  opts.min_replicas = 1;
+  opts.join_timeout_ms = 0;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+
+  // 1 joined, 2 alive -> 1 <= 2/2 -> no quorum (split-brain guard).
+  LighthouseState state;
+  state.participants["a"] = {now - Millis(1000), member("a")};
+  state.heartbeats["a"] = now;
+  state.heartbeats["b"] = now;
+  auto [met, reason] = quorum_compute(now, state, opts);
+  CHECK(!met.has_value());
+  CHECK(reason.find("at least half") != std::string::npos);
+
+  // 2 joined of 3 alive -> majority -> quorum (join_timeout=0).
+  state.participants["b"] = {now, member("b")};
+  state.heartbeats["c"] = now;
+  auto [met2, _] = quorum_compute(now, state, opts);
+  CHECK(met2.has_value());
+}
+
+static void test_quorum_shrink_only() {
+  LighthouseOpts opts;
+  opts.min_replicas = 1;
+  opts.join_timeout_ms = 0;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+
+  LighthouseState state;
+  QuorumSnapshot prev;
+  prev.quorum_id = 1;
+  prev.participants = {member("a"), member("b")};
+  state.prev_quorum = prev;
+
+  auto m_a = member("a");
+  m_a.shrink_only = true;
+  state.participants["a"] = {now, {m_a}};
+  state.participants["c"] = {now, member("c")};  // new joiner, filtered out
+  state.heartbeats["a"] = now;
+  state.heartbeats["c"] = now;
+
+  auto [met, _] = quorum_compute(now, state, opts);
+  CHECK(met.has_value());
+  CHECK(met->size() == 1);
+  CHECK((*met)[0].replica_id == "a");
+}
+
+// -------------------------------------------------- compute_quorum_results
+static QuorumSnapshot make_quorum(std::vector<QuorumMember> ms) {
+  QuorumSnapshot q;
+  q.quorum_id = 7;
+  q.participants = std::move(ms);
+  return q;
+}
+
+static void test_results_first_step_force_recover() {
+  // All replicas at step 0 with init_sync: everyone except the primary heals.
+  auto q = make_quorum({member("a", 0), member("b", 0), member("c", 0)});
+  auto ra = compute_quorum_results("a", 0, q, true);
+  CHECK(!ra.heal);  // "a" is primary (group_rank 0 % 3 max participants... )
+  CHECK(ra.recover_dst_replica_ranks.size() == 2);
+  auto rb = compute_quorum_results("b", 0, q, true);
+  CHECK(rb.heal);
+  CHECK(rb.recover_src_replica_rank.has_value() &&
+        *rb.recover_src_replica_rank == 0);
+  CHECK(rb.recover_src_manager_address == "addr_a");
+  // Without init_sync nobody heals at step 0.
+  auto rb2 = compute_quorum_results("b", 0, q, false);
+  CHECK(!rb2.heal);
+}
+
+static void test_results_behind_replica_heals() {
+  auto q = make_quorum({member("a", 10), member("b", 7), member("c", 10)});
+  auto rb = compute_quorum_results("b", 0, q, true);
+  CHECK(rb.heal);
+  CHECK(rb.max_step == 10);
+  CHECK(rb.replica_rank == 1);
+  CHECK(rb.replica_world_size == 3);
+  CHECK(rb.max_world_size == 2);
+  CHECK(!rb.max_replica_rank.has_value());
+  // Source must be one of the up-to-date replicas (ranks 0 or 2).
+  CHECK(rb.recover_src_replica_rank.has_value());
+  CHECK(*rb.recover_src_replica_rank == 0 || *rb.recover_src_replica_rank == 2);
+
+  auto ra = compute_quorum_results("a", 0, q, true);
+  CHECK(!ra.heal);
+  CHECK(ra.max_replica_rank.has_value() && *ra.max_replica_rank == 0);
+  // a's dst list + c's dst list together must cover replica 1.
+  auto rc = compute_quorum_results("c", 0, q, true);
+  size_t total = ra.recover_dst_replica_ranks.size() +
+                 rc.recover_dst_replica_ranks.size();
+  CHECK(total == 1);
+}
+
+static void test_results_store_spread_across_group_ranks() {
+  auto q = make_quorum({member("a", 5), member("b", 5)});
+  auto r0 = compute_quorum_results("a", 0, q, true);
+  auto r1 = compute_quorum_results("a", 1, q, true);
+  CHECK(r0.store_address == "store_a");
+  CHECK(r1.store_address == "store_b");
+}
+
+static void test_results_not_in_quorum() {
+  auto q = make_quorum({member("a", 0)});
+  bool threw = false;
+  try {
+    compute_quorum_results("z", 0, q, true);
+  } catch (const RpcError& e) {
+    threw = e.code == "not_found";
+  }
+  CHECK(threw);
+}
+
+static void test_results_commit_failures_max() {
+  auto a = member("a", 3);
+  a.commit_failures = 2;
+  auto q = make_quorum({a, member("b", 3)});
+  auto r = compute_quorum_results("b", 0, q, true);
+  CHECK(r.commit_failures == 2);
+  CHECK(r.replica_ids.size() == 2);
+}
+
+// ----------------------------------------------------------------- wire e2e
+static void test_wire_echo_and_timeout() {
+  RpcServer server("127.0.0.1:0", [](const std::string& method, const Json& p,
+                                     TimePoint deadline) -> Json {
+    if (method == "echo") return p;
+    if (method == "sleep") {
+      std::this_thread::sleep_for(Millis(p.get("ms").as_int()));
+      return Json::object();
+    }
+    if (method == "block_until_deadline") {
+      while (Clock::now() < deadline) std::this_thread::sleep_for(Millis(5));
+      throw TimeoutError("deadline reached");
+    }
+    throw RpcError("invalid", "unknown");
+  });
+
+  RpcClient client("127.0.0.1:" + std::to_string(server.port()), Millis(2000));
+  Json p = Json::object();
+  p["x"] = int64_t{42};
+  Json r = client.call("echo", p, Millis(2000));
+  CHECK(r.get("x").as_int() == 42);
+
+  bool timed_out = false;
+  try {
+    client.call("block_until_deadline", Json::object(), Millis(200));
+  } catch (const TimeoutError&) {
+    timed_out = true;
+  }
+  CHECK(timed_out);
+
+  bool invalid = false;
+  try {
+    client.call("nope", Json::object(), Millis(2000));
+  } catch (const RpcError& e) {
+    invalid = e.code == "invalid";
+  }
+  CHECK(invalid);
+  server.shutdown();
+}
+
+// ------------------------------------------------------------- kvstore e2e
+static void test_kvstore() {
+  KvStoreServer store("127.0.0.1:0");
+  RpcClient client("127.0.0.1:" + std::to_string(store.port()), Millis(2000));
+
+  Json setp = Json::object();
+  setp["key"] = std::string("k1");
+  setp["value"] = std::string("v1");
+  client.call("set", setp, Millis(2000));
+
+  Json getp = Json::object();
+  getp["key"] = std::string("k1");
+  CHECK(client.call("get", getp, Millis(2000)).get("value").as_string() == "v1");
+
+  // Blocking get resolved by a concurrent set.
+  std::thread setter([&] {
+    std::this_thread::sleep_for(Millis(100));
+    Json p = Json::object();
+    p["key"] = std::string("k2");
+    p["value"] = std::string("v2");
+    RpcClient c2("127.0.0.1:" + std::to_string(store.port()), Millis(2000));
+    c2.call("set", p, Millis(2000));
+  });
+  Json get2 = Json::object();
+  get2["key"] = std::string("k2");
+  CHECK(client.call("get", get2, Millis(5000)).get("value").as_string() == "v2");
+  setter.join();
+
+  // Atomic add (barrier counter pattern).
+  Json addp = Json::object();
+  addp["key"] = std::string("ctr");
+  addp["amount"] = int64_t{1};
+  CHECK(client.call("add", addp, Millis(2000)).get("value").as_int() == 1);
+  CHECK(client.call("add", addp, Millis(2000)).get("value").as_int() == 2);
+
+  // Timeout on missing key.
+  bool timed_out = false;
+  try {
+    Json p = Json::object();
+    p["key"] = std::string("missing");
+    client.call("get", p, Millis(200));
+  } catch (const TimeoutError&) {
+    timed_out = true;
+  }
+  CHECK(timed_out);
+  store.shutdown();
+}
+
+// --------------------------------------------------- lighthouse+manager e2e
+static void test_lighthouse_manager_e2e() {
+  LighthouseOpts lopts;
+  lopts.min_replicas = 2;
+  lopts.join_timeout_ms = 100;
+  lopts.quorum_tick_ms = 20;
+  lopts.heartbeat_timeout_ms = 5000;
+  Lighthouse lighthouse("127.0.0.1:0", lopts);
+  std::string lh_addr = "127.0.0.1:" + std::to_string(lighthouse.port());
+
+  ManagerOpts mo_a;
+  mo_a.replica_id = "rep_a";
+  mo_a.lighthouse_addr = lh_addr;
+  mo_a.hostname = "127.0.0.1";
+  mo_a.bind = "127.0.0.1:0";
+  mo_a.store_addr = "store_a";
+  mo_a.world_size = 2;  // two ranks in this group
+  ManagerServer mgr_a(mo_a);
+
+  ManagerOpts mo_b = mo_a;
+  mo_b.replica_id = "rep_b";
+  mo_b.store_addr = "store_b";
+  mo_b.world_size = 1;
+  ManagerServer mgr_b(mo_b);
+
+  auto quorum_call = [](int port, int64_t group_rank, int64_t step) {
+    RpcClient c("127.0.0.1:" + std::to_string(port), Millis(2000));
+    Json p = Json::object();
+    p["group_rank"] = group_rank;
+    p["step"] = step;
+    p["checkpoint_metadata"] = std::string("meta");
+    p["init_sync"] = true;
+    return c.call("quorum", p, Millis(10000));
+  };
+
+  // Group a needs both ranks to arrive before it forwards to the lighthouse.
+  Json ra0, ra1, rb0;
+  std::thread ta0([&] { ra0 = quorum_call(mgr_a.port(), 0, 0); });
+  std::thread ta1([&] { ra1 = quorum_call(mgr_a.port(), 1, 0); });
+  std::thread tb0([&] { rb0 = quorum_call(mgr_b.port(), 0, 0); });
+  ta0.join();
+  ta1.join();
+  tb0.join();
+
+  CHECK(ra0.get("replica_world_size").as_int() == 2);
+  CHECK(ra0.get("quorum_id").as_int() == rb0.get("quorum_id").as_int());
+  CHECK(ra0.get("replica_rank").as_int() == 0);   // rep_a sorts first
+  CHECK(rb0.get("replica_rank").as_int() == 1);
+  // Rank 1 of group a uses the second max-participant's store.
+  CHECK(ra0.get("store_address").as_string() == "store_a");
+  CHECK(ra1.get("store_address").as_string() == "store_b");
+  // init_sync at step 0: non-primary heals from primary.
+  CHECK(rb0.get("heal").as_bool() == true);
+  CHECK(ra0.get("heal").as_bool() == false);
+
+  // checkpoint_metadata fetch.
+  RpcClient ca("127.0.0.1:" + std::to_string(mgr_a.port()), Millis(2000));
+  Json cp = Json::object();
+  cp["rank"] = int64_t{0};
+  CHECK(ca.call("checkpoint_metadata", cp, Millis(2000))
+            .get("checkpoint_metadata")
+            .as_string() == "meta");
+
+  // 2-phase should_commit: one rank voting false vetoes the group.
+  auto vote = [](int port, int64_t rank, bool ok) {
+    RpcClient c("127.0.0.1:" + std::to_string(port), Millis(2000));
+    Json p = Json::object();
+    p["group_rank"] = rank;
+    p["step"] = int64_t{0};
+    p["should_commit"] = ok;
+    return c.call("should_commit", p, Millis(5000)).get("should_commit").as_bool();
+  };
+  bool d0 = false, d1 = false;
+  std::thread v0([&] { d0 = vote(mgr_a.port(), 0, true); });
+  std::thread v1([&] { d1 = vote(mgr_a.port(), 1, false); });
+  v0.join();
+  v1.join();
+  CHECK(d0 == false && d1 == false);
+
+  std::thread v2([&] { d0 = vote(mgr_a.port(), 0, true); });
+  std::thread v3([&] { d1 = vote(mgr_a.port(), 1, true); });
+  v2.join();
+  v3.join();
+  CHECK(d0 == true && d1 == true);
+
+  // Second quorum round: fast path (same membership) keeps quorum_id stable.
+  Json ra0b, ra1b, rb0b;
+  std::thread sa0([&] { ra0b = quorum_call(mgr_a.port(), 0, 1); });
+  std::thread sa1([&] { ra1b = quorum_call(mgr_a.port(), 1, 1); });
+  std::thread sb0([&] { rb0b = quorum_call(mgr_b.port(), 0, 1); });
+  sa0.join();
+  sa1.join();
+  sb0.join();
+  CHECK(ra0b.get("quorum_id").as_int() == ra0.get("quorum_id").as_int());
+
+  mgr_a.shutdown();
+  mgr_b.shutdown();
+  lighthouse.shutdown();
+}
+
+int main() {
+  test_quorum_fast_path();
+  test_quorum_join_timeout_straggler();
+  test_quorum_min_replicas();
+  test_quorum_expired_heartbeat();
+  test_quorum_split_brain();
+  test_quorum_shrink_only();
+  test_results_first_step_force_recover();
+  test_results_behind_replica_heals();
+  test_results_store_spread_across_group_ranks();
+  test_results_not_in_quorum();
+  test_results_commit_failures_max();
+  test_wire_echo_and_timeout();
+  test_kvstore();
+  test_lighthouse_manager_e2e();
+  if (failures == 0) {
+    std::printf("native_test: all tests passed\n");
+    return 0;
+  }
+  std::printf("native_test: %d failures\n", failures);
+  return 1;
+}

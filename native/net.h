@@ -1,0 +1,74 @@
+// POSIX TCP helpers with deadlines for the control-plane wire protocol.
+// Equivalent role to the reference's src/net.rs (channel connect with
+// keepalive + backoff retry) but for raw sockets.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <optional>
+#include <string>
+
+namespace tft {
+
+using Clock = std::chrono::steady_clock;
+using TimePoint = Clock::time_point;
+using Millis = std::chrono::milliseconds;
+
+inline TimePoint deadline_from_ms(int64_t ms) { return Clock::now() + Millis(ms); }
+int64_t ms_until(TimePoint deadline);
+
+// RAII socket fd.
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+  ~Socket();
+
+  int fd() const { return fd_; }
+  bool valid() const { return fd_ >= 0; }
+  void close();
+
+  // All throw std::runtime_error on failure; timeout errors contain "timed out".
+  void send_all(const void* data, size_t len, TimePoint deadline);
+  void recv_all(void* data, size_t len, TimePoint deadline);
+  // Peek up to len bytes without consuming (used for HTTP-vs-frame sniffing).
+  size_t peek(void* data, size_t len, TimePoint deadline);
+
+ private:
+  int fd_ = -1;
+};
+
+// Listener bound to host:port (port 0 -> ephemeral). Accept with timeout.
+class Listener {
+ public:
+  // bind format: "host:port". Throws on failure.
+  explicit Listener(const std::string& bind);
+  ~Listener();
+  Listener(const Listener&) = delete;
+
+  // Local port actually bound.
+  int port() const { return port_; }
+  // Blocks up to timeout; returns nullopt on timeout, throws on error.
+  // Wakes and returns nullopt promptly after shutdown().
+  std::optional<Socket> accept(Millis timeout);
+  void shutdown();
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+// Connect with deadline; retries with backoff until deadline (reference
+// behavior: src/net.rs:16-42 connect retry loop).
+Socket connect_with_retry(const std::string& host, int port, TimePoint deadline);
+
+// Parse "host:port" (supports "[v6]:port").
+std::pair<std::string, int> split_host_port(const std::string& addr);
+
+std::string local_hostname();
+
+}  // namespace tft

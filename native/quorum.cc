@@ -1,0 +1,274 @@
+#include "quorum.h"
+
+#include <algorithm>
+#include <set>
+
+#include "wire.h"
+
+namespace tft {
+
+int64_t epoch_millis_now() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+Json QuorumMember::to_json() const {
+  Json j = Json::object();
+  j["replica_id"] = replica_id;
+  j["address"] = address;
+  j["store_address"] = store_address;
+  j["step"] = step;
+  j["world_size"] = world_size;
+  j["shrink_only"] = shrink_only;
+  j["commit_failures"] = commit_failures;
+  j["data"] = data;
+  return j;
+}
+
+QuorumMember QuorumMember::from_json(const Json& j) {
+  QuorumMember m;
+  m.replica_id = j.get("replica_id").as_string();
+  m.address = j.get_or("address", Json("")).as_string();
+  m.store_address = j.get_or("store_address", Json("")).as_string();
+  m.step = j.get_or("step", Json(int64_t{0})).as_int();
+  m.world_size = j.get_or("world_size", Json(int64_t{1})).as_int();
+  m.shrink_only = j.get_or("shrink_only", Json(false)).as_bool();
+  m.commit_failures = j.get_or("commit_failures", Json(int64_t{0})).as_int();
+  m.data = j.get_or("data", Json("")).as_string();
+  return m;
+}
+
+Json QuorumSnapshot::to_json() const {
+  Json j = Json::object();
+  j["quorum_id"] = quorum_id;
+  Json parts = Json::array();
+  for (const auto& p : participants) parts.push_back(p.to_json());
+  j["participants"] = parts;
+  j["created_ms"] = created_ms;
+  return j;
+}
+
+QuorumSnapshot QuorumSnapshot::from_json(const Json& j) {
+  QuorumSnapshot q;
+  q.quorum_id = j.get("quorum_id").as_int();
+  for (const auto& p : j.get("participants").as_array())
+    q.participants.push_back(QuorumMember::from_json(p));
+  q.created_ms = j.get_or("created_ms", Json(int64_t{0})).as_int();
+  return q;
+}
+
+bool quorum_changed(const std::vector<QuorumMember>& a,
+                    const std::vector<QuorumMember>& b) {
+  if (a.size() != b.size()) return true;
+  for (size_t i = 0; i < a.size(); ++i)
+    if (a[i].replica_id != b[i].replica_id) return true;
+  return false;
+}
+
+std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
+    TimePoint now, const LighthouseState& state, const LighthouseOpts& opts) {
+  // Health: a replica is healthy if its last heartbeat is fresh.
+  std::set<std::string> healthy_replicas;
+  for (const auto& [rid, last] : state.heartbeats) {
+    if (now - last < Millis(opts.heartbeat_timeout_ms))
+      healthy_replicas.insert(rid);
+  }
+
+  std::map<std::string, const MemberDetails*> healthy_participants;
+  for (const auto& [rid, details] : state.participants) {
+    if (healthy_replicas.count(rid)) healthy_participants[rid] = &details;
+  }
+
+  std::vector<QuorumMember> candidates;
+  for (const auto& [rid, details] : healthy_participants)
+    candidates.push_back(details->member);
+  // std::map iteration is already sorted by replica_id -> deterministic order.
+
+  bool shrink_only = std::any_of(
+      healthy_participants.begin(), healthy_participants.end(),
+      [](const auto& kv) { return kv.second->member.shrink_only; });
+
+  std::string metadata = "[" + std::to_string(healthy_participants.size()) +
+                         "/" + std::to_string(state.participants.size()) +
+                         " participants healthy][" +
+                         std::to_string(healthy_replicas.size()) +
+                         " heartbeating][shrink_only=" +
+                         (shrink_only ? "true" : "false") + "]";
+
+  // Fast quorum: every member of the previous quorum is healthy and has
+  // re-joined -> no need to wait for the join timeout.
+  if (state.prev_quorum.has_value()) {
+    const auto& prev = *state.prev_quorum;
+    if (shrink_only) {
+      std::set<std::string> prev_ids;
+      for (const auto& p : prev.participants) prev_ids.insert(p.replica_id);
+      std::vector<QuorumMember> filtered;
+      for (auto& c : candidates)
+        if (prev_ids.count(c.replica_id)) filtered.push_back(c);
+      candidates = std::move(filtered);
+    }
+    bool fast = std::all_of(
+        prev.participants.begin(), prev.participants.end(),
+        [&](const QuorumMember& m) {
+          return healthy_participants.count(m.replica_id) > 0;
+        });
+    if (fast) {
+      return {candidates, "Fast quorum found! " + metadata};
+    }
+  }
+
+  if (static_cast<int64_t>(healthy_participants.size()) < opts.min_replicas) {
+    return {std::nullopt,
+            "New quorum not ready, only have " +
+                std::to_string(healthy_participants.size()) +
+                " participants, need min_replicas " +
+                std::to_string(opts.min_replicas) + " " + metadata};
+  }
+
+  // Split-brain guard: require a strict majority of known-alive replicas.
+  if (healthy_participants.size() <= healthy_replicas.size() / 2) {
+    return {std::nullopt,
+            "New quorum not ready, only have " +
+                std::to_string(healthy_participants.size()) +
+                " participants, need at least half of " +
+                std::to_string(healthy_replicas.size()) + " healthy workers " +
+                metadata};
+  }
+
+  // Wait for stragglers that are alive but haven't re-joined yet, up to the
+  // join timeout measured from the first joiner.
+  bool all_healthy_joined =
+      healthy_participants.size() == healthy_replicas.size();
+  TimePoint first_joined = now;
+  for (const auto& [rid, details] : healthy_participants)
+    first_joined = std::min(first_joined, details->joined);
+  if (!all_healthy_joined &&
+      now - first_joined < Millis(opts.join_timeout_ms)) {
+    return {std::nullopt,
+            "Valid quorum with " +
+                std::to_string(healthy_participants.size()) +
+                " participants, waiting for " +
+                std::to_string(healthy_replicas.size() -
+                               healthy_participants.size()) +
+                " healthy but not participating stragglers due to join "
+                "timeout " +
+                metadata};
+  }
+
+  return {candidates, "Valid quorum found " + metadata};
+}
+
+Json ManagerQuorumResult::to_json() const {
+  Json j = Json::object();
+  j["quorum_id"] = quorum_id;
+  j["recover_src_manager_address"] = recover_src_manager_address;
+  j["recover_src_replica_rank"] =
+      recover_src_replica_rank ? Json(*recover_src_replica_rank) : Json();
+  Json dsts = Json::array();
+  for (auto r : recover_dst_replica_ranks) dsts.push_back(r);
+  j["recover_dst_replica_ranks"] = dsts;
+  j["store_address"] = store_address;
+  j["max_step"] = max_step;
+  j["max_replica_rank"] = max_replica_rank ? Json(*max_replica_rank) : Json();
+  j["max_world_size"] = max_world_size;
+  j["replica_rank"] = replica_rank;
+  j["replica_world_size"] = replica_world_size;
+  j["heal"] = heal;
+  j["commit_failures"] = commit_failures;
+  Json ids = Json::array();
+  for (const auto& id : replica_ids) ids.push_back(id);
+  j["replica_ids"] = ids;
+  return j;
+}
+
+ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
+                                           int64_t group_rank,
+                                           const QuorumSnapshot& quorum,
+                                           bool init_sync) {
+  std::vector<QuorumMember> participants = quorum.participants;
+  std::sort(participants.begin(), participants.end(),
+            [](const QuorumMember& a, const QuorumMember& b) {
+              return a.replica_id < b.replica_id;
+            });
+
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < participants.size(); ++i) {
+    if (participants[i].replica_id == replica_id) {
+      replica_rank = static_cast<int64_t>(i);
+      break;
+    }
+  }
+  if (replica_rank < 0)
+    throw RpcError("not_found", "replica " + replica_id +
+                                    " not participating in returned quorum");
+
+  int64_t max_step = 0;
+  for (const auto& p : participants) max_step = std::max(max_step, p.step);
+
+  std::vector<size_t> max_idx;  // indices of participants at max_step
+  for (size_t i = 0; i < participants.size(); ++i)
+    if (participants[i].step == max_step) max_idx.push_back(i);
+
+  std::optional<int64_t> max_replica_rank;
+  for (size_t i = 0; i < max_idx.size(); ++i)
+    if (participants[max_idx[i]].replica_id == replica_id)
+      max_replica_rank = static_cast<int64_t>(i);
+
+  // One KV store per replica group; ranks of each group spread across the
+  // stores of the max-step participants for load balancing.
+  const QuorumMember& primary =
+      participants[max_idx[static_cast<size_t>(group_rank) % max_idx.size()]];
+
+  // A replica recovers if it is behind, or (on a cold start with init_sync)
+  // if it is not the primary — forcing everyone to adopt the primary's
+  // initialization so all replicas start bitwise identical.
+  bool force_recover = init_sync && max_step == 0;
+  std::vector<size_t> recovering;
+  for (size_t i = 0; i < participants.size(); ++i) {
+    const auto& p = participants[i];
+    if (p.step != max_step ||
+        (force_recover && primary.replica_id != p.replica_id))
+      recovering.push_back(i);
+  }
+  std::set<size_t> recovering_set(recovering.begin(), recovering.end());
+  std::vector<size_t> up_to_date;
+  for (size_t i = 0; i < participants.size(); ++i)
+    if (!recovering_set.count(i)) up_to_date.push_back(i);
+
+  // Round-robin assignment of recovery sources, offset by group_rank so the
+  // ranks of one recovering replica spread their fetches across sources.
+  std::map<size_t, std::vector<int64_t>> assignments;  // src idx -> dst ranks
+  std::optional<int64_t> recover_src_replica_rank;
+  for (size_t i = 0; i < recovering.size(); ++i) {
+    size_t src =
+        up_to_date[(i + static_cast<size_t>(group_rank)) % up_to_date.size()];
+    assignments[src].push_back(static_cast<int64_t>(recovering[i]));
+    if (static_cast<int64_t>(recovering[i]) == replica_rank)
+      recover_src_replica_rank = static_cast<int64_t>(src);
+  }
+
+  ManagerQuorumResult r;
+  r.quorum_id = quorum.quorum_id;
+  r.recover_src_replica_rank = recover_src_replica_rank;
+  r.recover_src_manager_address =
+      recover_src_replica_rank
+          ? participants[static_cast<size_t>(*recover_src_replica_rank)].address
+          : "";
+  auto it = assignments.find(static_cast<size_t>(replica_rank));
+  if (it != assignments.end()) r.recover_dst_replica_ranks = it->second;
+  r.store_address = primary.store_address;
+  r.max_step = max_step;
+  r.max_replica_rank = max_replica_rank;
+  r.max_world_size = static_cast<int64_t>(max_idx.size());
+  r.replica_rank = replica_rank;
+  r.replica_world_size = static_cast<int64_t>(participants.size());
+  r.heal = recover_src_replica_rank.has_value();
+  int64_t cf = 0;
+  for (const auto& p : participants) cf = std::max(cf, p.commit_failures);
+  r.commit_failures = cf;
+  for (const auto& p : participants) r.replica_ids.push_back(p.replica_id);
+  return r;
+}
+
+}  // namespace tft

@@ -1,0 +1,105 @@
+// Pure quorum logic for the torchft_tpu control plane.
+//
+// Semantics match the reference implementation:
+// - lighthouse quorum computation: heartbeat health, fast-quorum when all
+//   previous members are healthy, min_replicas gate, split-brain majority
+//   check, join-timeout straggler wait, shrink_only filtering
+//   (reference: src/lighthouse.rs:141-269)
+// - per-rank manager results: sorted replica ranks, max-step participants,
+//   primary store selection, round-robin recovery assignment, init_sync
+//   force-recovery (reference: src/manager.rs:489-625)
+// These are pure functions over value types so they unit-test without any
+// server running, exactly like the reference's Rust test suites.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "net.h"
+
+namespace tft {
+
+struct QuorumMember {
+  std::string replica_id;
+  std::string address;        // manager RPC address (host:port)
+  std::string store_address;  // rendezvous KV store address
+  int64_t step = 0;
+  int64_t world_size = 1;     // group world size (ranks inside the replica)
+  bool shrink_only = false;
+  int64_t commit_failures = 0;
+  std::string data;           // user payload, JSON string
+
+  Json to_json() const;
+  static QuorumMember from_json(const Json& j);
+  bool operator==(const QuorumMember& o) const {
+    return replica_id == o.replica_id;
+  }
+};
+
+struct QuorumSnapshot {
+  int64_t quorum_id = 0;
+  std::vector<QuorumMember> participants;
+  int64_t created_ms = 0;  // epoch millis
+
+  Json to_json() const;
+  static QuorumSnapshot from_json(const Json& j);
+};
+
+struct LighthouseOpts {
+  int64_t min_replicas = 1;
+  int64_t join_timeout_ms = 60000;
+  int64_t quorum_tick_ms = 100;
+  int64_t heartbeat_timeout_ms = 5000;
+};
+
+struct MemberDetails {
+  TimePoint joined;
+  QuorumMember member;
+};
+
+struct LighthouseState {
+  std::map<std::string, MemberDetails> participants;  // replica_id -> details
+  std::map<std::string, TimePoint> heartbeats;        // replica_id -> last beat
+  std::optional<QuorumSnapshot> prev_quorum;
+  int64_t quorum_id = 0;
+};
+
+// Returns (participants or nullopt, human-readable reason).
+std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
+    TimePoint now, const LighthouseState& state, const LighthouseOpts& opts);
+
+// True if membership (ordered replica_id list) differs.
+bool quorum_changed(const std::vector<QuorumMember>& a,
+                    const std::vector<QuorumMember>& b);
+
+struct ManagerQuorumResult {
+  int64_t quorum_id = 0;
+  std::string recover_src_manager_address;
+  std::optional<int64_t> recover_src_replica_rank;
+  std::vector<int64_t> recover_dst_replica_ranks;
+  std::string store_address;
+  int64_t max_step = 0;
+  std::optional<int64_t> max_replica_rank;
+  int64_t max_world_size = 0;
+  int64_t replica_rank = 0;
+  int64_t replica_world_size = 0;
+  bool heal = false;
+  int64_t commit_failures = 0;
+  std::vector<std::string> replica_ids;
+
+  Json to_json() const;
+};
+
+// Throws RpcError("not_found") if replica_id is not in the quorum.
+ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
+                                           int64_t group_rank,
+                                           const QuorumSnapshot& quorum,
+                                           bool init_sync);
+
+int64_t epoch_millis_now();
+
+}  // namespace tft

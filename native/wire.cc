@@ -1,0 +1,243 @@
+#include "wire.h"
+
+#include <cstring>
+
+namespace tft {
+namespace {
+
+constexpr size_t kMaxFrame = 512ull << 20;  // 512 MiB hard cap
+
+void write_frame(Socket& sock, const std::string& payload, TimePoint deadline) {
+  if (payload.size() > kMaxFrame) throw std::runtime_error("frame too large");
+  uint8_t hdr[4] = {
+      static_cast<uint8_t>((payload.size() >> 24) & 0xFF),
+      static_cast<uint8_t>((payload.size() >> 16) & 0xFF),
+      static_cast<uint8_t>((payload.size() >> 8) & 0xFF),
+      static_cast<uint8_t>(payload.size() & 0xFF),
+  };
+  sock.send_all(hdr, 4, deadline);
+  sock.send_all(payload.data(), payload.size(), deadline);
+}
+
+std::string read_frame(Socket& sock, TimePoint deadline) {
+  uint8_t hdr[4];
+  sock.recv_all(hdr, 4, deadline);
+  size_t len = (static_cast<size_t>(hdr[0]) << 24) |
+               (static_cast<size_t>(hdr[1]) << 16) |
+               (static_cast<size_t>(hdr[2]) << 8) | static_cast<size_t>(hdr[3]);
+  if (len > kMaxFrame) throw std::runtime_error("frame too large");
+  std::string payload(len, '\0');
+  if (len > 0) sock.recv_all(payload.data(), len, deadline);
+  return payload;
+}
+
+}  // namespace
+
+RpcServer::RpcServer(const std::string& bind, Handler handler, HttpHandler http)
+    : listener_(std::make_unique<Listener>(bind)),
+      handler_(std::move(handler)),
+      http_(std::move(http)) {
+  accept_thread_ = std::thread([this] { accept_loop(); });
+}
+
+RpcServer::~RpcServer() { shutdown(); }
+
+void RpcServer::shutdown() {
+  bool was_running = running_.exchange(false);
+  if (!was_running) return;
+  listener_->shutdown();
+  {
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (auto& c : conns_) c->close();
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::vector<std::unique_ptr<ConnSlot>> slots;
+  {
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    slots.swap(conn_slots_);
+  }
+  for (auto& s : slots)
+    if (s->thread.joinable()) s->thread.join();
+}
+
+void RpcServer::reap_finished_locked() {
+  auto it = conn_slots_.begin();
+  while (it != conn_slots_.end()) {
+    if ((*it)->done.load()) {
+      if ((*it)->thread.joinable()) (*it)->thread.join();
+      it = conn_slots_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void RpcServer::accept_loop() {
+  while (running_.load()) {
+    std::optional<Socket> sock;
+    try {
+      sock = listener_->accept(Millis(200));
+    } catch (const std::exception&) {
+      if (!running_.load()) return;
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    reap_finished_locked();
+    if (!sock) continue;
+    if (!running_.load()) return;
+    auto sp = std::make_shared<Socket>(std::move(*sock));
+    conns_.insert(sp);
+    auto slot = std::make_unique<ConnSlot>();
+    ConnSlot* slot_ptr = slot.get();
+    slot_ptr->thread = std::thread([this, sp, slot_ptr] {
+      serve_conn(sp);
+      {
+        std::lock_guard<std::mutex> lk2(conn_mu_);
+        conns_.erase(sp);
+      }
+      slot_ptr->done.store(true);
+    });
+    conn_slots_.push_back(std::move(slot));
+  }
+}
+
+void RpcServer::serve_conn(std::shared_ptr<Socket> sock) {
+  try {
+    // Sniff: HTTP request lines start with an ASCII method ("GET ", "POST",
+    // "HEAD"); our frames start with a 4-byte length whose first byte is
+    // 0x00 for any sane payload (<16 MiB).
+    char probe[4] = {0};
+    size_t n = sock->peek(probe, 4, Clock::now() + Millis(30000));
+    bool is_http = n >= 3 && (memcmp(probe, "GET", 3) == 0 ||
+                              memcmp(probe, "POS", 3) == 0 ||
+                              memcmp(probe, "HEA", 3) == 0);
+    if (is_http) {
+      serve_http(*sock, "");
+      return;
+    }
+    while (running_.load()) {
+      // Idle keep-alive: wait up to 1h for the next request frame.
+      std::string req_text = read_frame(*sock, Clock::now() + Millis(3600000));
+      Json resp = Json::object();
+      try {
+        Json req = Json::parse(req_text);
+        std::string method = req.get("method").as_string();
+        int64_t timeout_ms = req.get_or("timeout_ms", Json(int64_t{60000})).as_int();
+        Json params = req.get_or("params", Json::object());
+        Json result = handler_(method, params, deadline_from_ms(timeout_ms));
+        resp["ok"] = true;
+        resp["result"] = result;
+      } catch (const RpcError& e) {
+        resp["ok"] = false;
+        resp["code"] = e.code;
+        resp["error"] = std::string(e.what());
+      } catch (const std::exception& e) {
+        resp["ok"] = false;
+        std::string msg = e.what();
+        resp["code"] = msg.find("timed out") != std::string::npos
+                           ? std::string("timeout")
+                           : std::string("internal");
+        resp["error"] = msg;
+      }
+      write_frame(*sock, resp.dump(), Clock::now() + Millis(60000));
+    }
+  } catch (const std::exception&) {
+    // connection closed / timed out: drop it
+  }
+}
+
+void RpcServer::serve_http(Socket& sock, const std::string&) {
+  try {
+    // Read until end of headers (tiny requests only; dashboards).
+    std::string buf;
+    char c;
+    TimePoint deadline = Clock::now() + Millis(10000);
+    while (buf.find("\r\n\r\n") == std::string::npos && buf.size() < 16384) {
+      sock.recv_all(&c, 1, deadline);
+      buf.push_back(c);
+    }
+    auto line_end = buf.find("\r\n");
+    std::string line = buf.substr(0, line_end);
+    auto sp1 = line.find(' ');
+    auto sp2 = line.rfind(' ');
+    std::string method = line.substr(0, sp1);
+    std::string path =
+        sp2 > sp1 ? line.substr(sp1 + 1, sp2 - sp1 - 1) : std::string("/");
+    std::string status = "404 Not Found", ctype = "text/plain", body = "not found";
+    if (http_) std::tie(status, ctype, body) = http_(method, path);
+    std::string resp = "HTTP/1.1 " + status +
+                       "\r\nContent-Type: " + ctype +
+                       "\r\nContent-Length: " + std::to_string(body.size()) +
+                       "\r\nConnection: close\r\n\r\n" + body;
+    sock.send_all(resp.data(), resp.size(), Clock::now() + Millis(10000));
+  } catch (const std::exception&) {
+  }
+}
+
+RpcClient::RpcClient(std::string addr, Millis connect_timeout)
+    : addr_(std::move(addr)), connect_timeout_(connect_timeout) {}
+
+Socket RpcClient::dial(Millis timeout) {
+  auto [host, port] = split_host_port(addr_);
+  TimePoint connect_deadline = Clock::now() + std::min(connect_timeout_, timeout);
+  return connect_with_retry(host, port, connect_deadline);
+}
+
+Json RpcClient::call_on(Socket& sock, const std::string& method,
+                        const Json& params, Millis timeout) {
+  // Full-call deadline: the handler may legitimately block for the entire
+  // timeout (quorum waits); allow a small grace for the response to arrive.
+  TimePoint deadline = Clock::now() + timeout + Millis(2000);
+  Json req = Json::object();
+  req["method"] = method;
+  req["params"] = params;
+  req["timeout_ms"] =
+      static_cast<int64_t>(std::chrono::duration_cast<Millis>(timeout).count());
+  write_frame(sock, req.dump(), deadline);
+  std::string resp_text = read_frame(sock, deadline);
+  Json resp = Json::parse(resp_text);
+  if (resp.get("ok").as_bool()) return resp.get_or("result", Json());
+  std::string code = resp.get_or("code", Json("internal")).as_string();
+  std::string err = resp.get_or("error", Json("unknown")).as_string();
+  if (code == "timeout") throw TimeoutError(err);
+  throw RpcError(code, err);
+}
+
+Json RpcClient::call(const std::string& method, const Json& params,
+                     Millis timeout) {
+  try {
+    std::unique_lock<std::mutex> lk(mu_, std::try_to_lock);
+    if (!lk.owns_lock()) {
+      // Cached connection busy with a (possibly long-blocking) call from
+      // another thread: use a one-shot connection so we never queue behind it.
+      Socket sock = dial(timeout);
+      return call_on(sock, method, params, timeout);
+    }
+    bool reused = cached_.valid();
+    if (!reused) cached_ = dial(timeout);
+    try {
+      return call_on(cached_, method, params, timeout);
+    } catch (const RpcError&) {
+      throw;  // server replied; connection is fine
+    } catch (const std::exception& e) {
+      cached_.close();
+      bool timed_out =
+          std::string(e.what()).find("timed out") != std::string::npos;
+      // Reconnect-and-retry only a *stale* cached connection (closed/reset by
+      // a restarted or idle-timing-out server). Timeouts and fresh-connection
+      // failures don't retry — the request may already have been processed.
+      if (!reused || timed_out) throw;
+      cached_ = dial(timeout);
+      return call_on(cached_, method, params, timeout);
+    }
+  } catch (const RpcError&) {
+    throw;
+  } catch (const std::exception& e) {
+    std::string msg = std::string(e.what());
+    if (msg.find("timed out") != std::string::npos)
+      throw TimeoutError(method + " to " + addr_ + ": " + msg);
+    throw RpcError("unavailable", method + " to " + addr_ + ": " + msg);
+  }
+}
+
+}  // namespace tft

@@ -1,0 +1,100 @@
+// Length-framed JSON RPC over TCP, with HTTP GET multiplexed on the same
+// listener (the reference multiplexes an axum HTTP dashboard and tonic gRPC
+// on one port, src/lighthouse.rs:362-400; we sniff the first bytes instead).
+//
+// Frame: 4-byte big-endian payload length + UTF-8 JSON.
+// Request  : {"method": str, "params": {...}, "timeout_ms": int}
+// Response : {"ok": true, "result": ...} | {"ok": false, "code": str, "error": str}
+// Codes: "timeout", "not_found", "invalid", "internal", "unavailable".
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"
+#include "net.h"
+
+namespace tft {
+
+// Thrown by handlers/clients to signal a typed RPC error.
+struct RpcError : std::runtime_error {
+  RpcError(std::string code_, const std::string& msg)
+      : std::runtime_error(msg), code(std::move(code_)) {}
+  std::string code;
+};
+
+struct TimeoutError : RpcError {
+  explicit TimeoutError(const std::string& msg) : RpcError("timeout", msg) {}
+};
+
+class RpcServer {
+ public:
+  using Handler =
+      std::function<Json(const std::string& method, const Json& params,
+                         TimePoint deadline)>;
+  // Returns (status_line_suffix e.g. "200 OK", content_type, body).
+  using HttpHandler = std::function<std::tuple<std::string, std::string, std::string>(
+      const std::string& method, const std::string& path)>;
+
+  RpcServer(const std::string& bind, Handler handler, HttpHandler http = nullptr);
+  ~RpcServer();
+
+  int port() const { return listener_->port(); }
+  void shutdown();
+
+ private:
+  void accept_loop();
+  void serve_conn(std::shared_ptr<Socket> sock);
+  void serve_http(Socket& sock, const std::string& deadline_hint);
+
+  std::unique_ptr<Listener> listener_;
+  Handler handler_;
+  HttpHandler http_;
+  std::atomic<bool> running_{true};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::set<std::shared_ptr<Socket>> conns_;
+  // One slot per live connection; finished slots are reaped (joined) by the
+  // accept loop so long-running servers don't accumulate dead threads.
+  struct ConnSlot {
+    std::thread thread;
+    std::atomic<bool> done{false};
+  };
+  std::vector<std::unique_ptr<ConnSlot>> conn_slots_;
+  void reap_finished_locked();
+};
+
+// Framed-JSON RPC client with a cached keep-alive connection.
+// The cached socket is reused across calls (reconnecting once if it went
+// stale — the reference's reconnect-on-failure behavior,
+// src/manager.rs:250-306). If another thread currently holds the cached
+// connection, the call transparently uses a one-shot connection instead, so
+// a long-blocking quorum call never delays concurrent heartbeats.
+class RpcClient {
+ public:
+  // addr: "host:port" (scheme prefixes tolerated).
+  RpcClient(std::string addr, Millis connect_timeout);
+
+  // Throws TimeoutError / RpcError / std::runtime_error.
+  Json call(const std::string& method, const Json& params, Millis timeout);
+
+  const std::string& addr() const { return addr_; }
+
+ private:
+  Json call_on(Socket& sock, const std::string& method, const Json& params,
+               Millis timeout);
+  Socket dial(Millis timeout);
+
+  std::string addr_;
+  Millis connect_timeout_;
+  std::mutex mu_;       // guards cached_
+  Socket cached_;       // invalid until first call
+};
+
+}  // namespace tft

@@ -1,0 +1,277 @@
+"""Tests for the native control plane through the Python bindings.
+
+Mirrors the reference's Rust unit tests (quorum_compute edge cases
+src/lighthouse.rs:627-1071, compute_quorum_results src/manager.rs:881-1108)
+plus client/server e2e.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from torchft_tpu.coordination import (
+    KvClient,
+    KvStoreServer,
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    compute_quorum_results,
+    quorum_compute,
+)
+
+
+def member(rid, step=0, **kw):
+    m = {
+        "replica_id": rid,
+        "address": f"addr_{rid}",
+        "store_address": f"store_{rid}",
+        "step": step,
+        "world_size": 1,
+        "shrink_only": False,
+        "commit_failures": 0,
+        "data": "",
+    }
+    m.update(kw)
+    return m
+
+
+class TestQuorumCompute:
+    OPTS = {"min_replicas": 1, "join_timeout_ms": 0, "heartbeat_timeout_ms": 5000}
+
+    def test_single_replica_quorum(self):
+        state = {
+            "participants": [{"member": member("a"), "joined_ms_ago": 0}],
+            "heartbeats": {"a": 0},
+            "prev_quorum": None,
+            "quorum_id": 0,
+        }
+        out = quorum_compute(state, self.OPTS)
+        assert out["participants"] is not None
+        assert [p["replica_id"] for p in out["participants"]] == ["a"]
+
+    def test_min_replicas_not_met(self):
+        state = {
+            "participants": [{"member": member("a"), "joined_ms_ago": 0}],
+            "heartbeats": {"a": 0},
+            "prev_quorum": None,
+        }
+        out = quorum_compute(state, {**self.OPTS, "min_replicas": 2})
+        assert out["participants"] is None
+        assert "min_replicas" in out["reason"]
+
+    def test_fast_quorum_prev_members_healthy(self):
+        prev = {"quorum_id": 3, "participants": [member("a"), member("b")]}
+        state = {
+            "participants": [
+                {"member": member("a"), "joined_ms_ago": 0},
+                {"member": member("b"), "joined_ms_ago": 0},
+            ],
+            "heartbeats": {"a": 0, "b": 0, "c": 0},  # c alive but not needed
+            "prev_quorum": prev,
+        }
+        out = quorum_compute(state, {**self.OPTS, "join_timeout_ms": 60000})
+        assert out["participants"] is not None
+        assert "Fast quorum" in out["reason"]
+
+    def test_expired_heartbeat_excluded(self):
+        state = {
+            "participants": [
+                {"member": member("a"), "joined_ms_ago": 0},
+                {"member": member("b"), "joined_ms_ago": 0},
+            ],
+            "heartbeats": {"a": 0, "b": 60000},
+            "prev_quorum": None,
+        }
+        out = quorum_compute(state, self.OPTS)
+        assert [p["replica_id"] for p in out["participants"]] == ["a"]
+
+    def test_straggler_wait_then_shrink(self):
+        state = {
+            "participants": [
+                {"member": member("a"), "joined_ms_ago": 100},
+                {"member": member("b"), "joined_ms_ago": 100},
+            ],
+            "heartbeats": {"a": 0, "b": 0, "c": 0},
+            "prev_quorum": None,
+        }
+        waiting = quorum_compute(state, {**self.OPTS, "join_timeout_ms": 60000})
+        assert waiting["participants"] is None
+        assert "straggler" in waiting["reason"]
+        shrunk = quorum_compute(state, {**self.OPTS, "join_timeout_ms": 50})
+        assert [p["replica_id"] for p in shrunk["participants"]] == ["a", "b"]
+
+    def test_split_brain_guard(self):
+        state = {
+            "participants": [{"member": member("a"), "joined_ms_ago": 0}],
+            "heartbeats": {"a": 0, "b": 0},
+            "prev_quorum": None,
+        }
+        out = quorum_compute(state, self.OPTS)
+        assert out["participants"] is None
+        assert "at least half" in out["reason"]
+
+    def test_shrink_only_filters_new_joiners(self):
+        prev = {"quorum_id": 1, "participants": [member("a"), member("b")]}
+        state = {
+            "participants": [
+                {"member": member("a", shrink_only=True), "joined_ms_ago": 0},
+                {"member": member("c"), "joined_ms_ago": 0},
+            ],
+            "heartbeats": {"a": 0, "c": 0},
+            "prev_quorum": prev,
+        }
+        out = quorum_compute(state, self.OPTS)
+        assert [p["replica_id"] for p in out["participants"]] == ["a"]
+
+
+class TestComputeQuorumResults:
+    def quorum(self, *members):
+        return {"quorum_id": 7, "participants": list(members)}
+
+    def test_behind_replica_heals_from_up_to_date(self):
+        q = self.quorum(member("a", 10), member("b", 7), member("c", 10))
+        rb = compute_quorum_results("b", 0, q)
+        assert rb.heal
+        assert rb.max_step == 10
+        assert rb.replica_rank == 1
+        assert rb.max_world_size == 2
+        assert rb.max_replica_rank is None
+        assert rb.recover_src_replica_rank in (0, 2)
+        assert rb.recover_src_manager_address in ("addr_a", "addr_c")
+
+    def test_init_sync_force_recover_from_primary(self):
+        q = self.quorum(member("a"), member("b"))
+        ra = compute_quorum_results("a", 0, q, init_sync=True)
+        rb = compute_quorum_results("b", 0, q, init_sync=True)
+        assert not ra.heal and rb.heal
+        assert ra.recover_dst_replica_ranks == [1]
+        assert rb.recover_src_replica_rank == 0
+
+    def test_no_init_sync_no_heal_at_step0(self):
+        q = self.quorum(member("a"), member("b"))
+        assert not compute_quorum_results("b", 0, q, init_sync=False).heal
+
+    def test_store_spread_by_group_rank(self):
+        q = self.quorum(member("a", 5), member("b", 5))
+        assert compute_quorum_results("a", 0, q).store_address == "store_a"
+        assert compute_quorum_results("a", 1, q).store_address == "store_b"
+
+    def test_unknown_replica_raises(self):
+        with pytest.raises(LookupError):
+            compute_quorum_results("zzz", 0, self.quorum(member("a")))
+
+    def test_commit_failures_propagate_max(self):
+        q = self.quorum(member("a", 3, commit_failures=2), member("b", 3))
+        assert compute_quorum_results("b", 0, q).commit_failures == 2
+
+
+class TestKvStore:
+    def test_set_get_add_check(self):
+        store = KvStoreServer("127.0.0.1:0")
+        try:
+            client = KvClient(f"127.0.0.1:{store.port}")
+            client.set("k", b"hello")
+            assert client.get("k") == b"hello"
+            assert client.check(["k"]) and not client.check(["nope"])
+            assert client.add("ctr", 2) == 2
+            assert client.add("ctr", 3) == 5
+            assert client.num_keys() == 2
+            assert client.delete("k")
+            with pytest.raises(TimeoutError):
+                client.get("never", timeout=0.2)
+        finally:
+            store.shutdown()
+
+    def test_blocking_get_resolved_by_other_client(self):
+        store = KvStoreServer("127.0.0.1:0")
+        try:
+            addr = f"127.0.0.1:{store.port}"
+            c1, c2 = KvClient(addr), KvClient(addr)
+
+            def setter():
+                import time
+
+                time.sleep(0.1)
+                c2.set("late", b"v")
+
+            t = threading.Thread(target=setter)
+            t.start()
+            assert c1.get("late", timeout=5.0) == b"v"
+            t.join()
+        finally:
+            store.shutdown()
+
+
+class TestLighthouseManagerE2E:
+    def test_two_replica_groups_quorum_and_commit(self):
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=100,
+            quorum_tick_ms=20,
+        )
+        lh_addr = f"127.0.0.1:{lh.port}"
+        mgr_a = ManagerServer(
+            replica_id="rep_a", lighthouse_addr=lh_addr, hostname="127.0.0.1",
+            bind="127.0.0.1:0", store_addr="store_a", world_size=1,
+        )
+        mgr_b = ManagerServer(
+            replica_id="rep_b", lighthouse_addr=lh_addr, hostname="127.0.0.1",
+            bind="127.0.0.1:0", store_addr="store_b", world_size=1,
+        )
+        try:
+            ca = ManagerClient(f"127.0.0.1:{mgr_a.port}")
+            cb = ManagerClient(f"127.0.0.1:{mgr_b.port}")
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fa = ex.submit(ca._quorum, 0, 0, "meta_a", False, 10.0)
+                fb = ex.submit(cb._quorum, 0, 0, "meta_b", False, 10.0)
+                ra, rb = fa.result(), fb.result()
+            assert ra.quorum_id == rb.quorum_id
+            assert ra.replica_rank == 0 and rb.replica_rank == 1
+            assert ra.replica_world_size == 2
+            assert rb.heal and not ra.heal  # init_sync at step 0
+            assert rb.recover_src_manager_address.endswith(str(mgr_a.port))
+            assert ca._checkpoint_metadata(0, 5.0) == "meta_a"
+            # both groups are world_size=1: should_commit resolves immediately
+            assert ca.should_commit(0, 0, True, 5.0)
+            assert not cb.should_commit(0, 0, False, 5.0)
+        finally:
+            mgr_a.shutdown()
+            mgr_b.shutdown()
+            lh.shutdown()
+
+    def test_lighthouse_client_direct_quorum(self):
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=100,
+            quorum_tick_ms=20,
+        )
+        try:
+            addr = f"127.0.0.1:{lh.port}"
+            c1, c2 = LighthouseClient(addr), LighthouseClient(addr)
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                f1 = ex.submit(
+                    c1.quorum, "rep_x", 10.0, "", "", 0, 1, False, {"k": 1}
+                )
+                f2 = ex.submit(c2.quorum, "rep_y", 10.0)
+                q1, q2 = f1.result(), f2.result()
+            assert q1.quorum_id == q2.quorum_id
+            ids = [p.replica_id for p in q1.participants]
+            assert ids == ["rep_x", "rep_y"]
+            assert q1.participants[0].data == '{"k": 1}'
+            c1.heartbeat("rep_x")
+            status = c1.status()
+            assert status["quorum_id"] >= 1
+        finally:
+            lh.shutdown()
+
+    def test_quorum_timeout_when_partner_missing(self):
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=60000,
+            quorum_tick_ms=20,
+        )
+        try:
+            c = LighthouseClient(f"127.0.0.1:{lh.port}")
+            with pytest.raises(TimeoutError):
+                c.quorum("lonely", 0.5)
+        finally:
+            lh.shutdown()

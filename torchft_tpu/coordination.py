@@ -1,0 +1,591 @@
+"""Python bindings for the native C++ control plane.
+
+Role-equivalent of the reference's pyo3 extension ``torchft._torchft``
+(reference: src/lib.rs:80-761, torchft/_torchft.pyi, torchft/coordination.py):
+``LighthouseServer``/``LighthouseClient``, ``ManagerServer``/``ManagerClient``,
+``QuorumResult``, plus the rendezvous ``KvStoreServer``/``KvClient`` (the
+TPU-native replacement for torch's TCPStore). The native side is C++
+(``native/`` -> ``torchft_tpu/_native/libtorchft_tpu.so``) speaking
+length-framed JSON over TCP; ctypes releases the GIL around every blocking
+RPC, matching the reference's ``py.allow_threads`` behavior.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Dict, List, Optional
+
+__all__ = [
+    "QuorumMember",
+    "Quorum",
+    "QuorumResult",
+    "LighthouseServer",
+    "LighthouseClient",
+    "ManagerServer",
+    "ManagerClient",
+    "KvStoreServer",
+    "KvClient",
+]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "_native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtorchft_tpu.so")
+
+# status codes from native/capi.cc
+_OK, _TIMEOUT, _ERROR, _NOT_FOUND, _INVALID, _UNAVAILABLE = range(6)
+
+
+def ensure_native_built() -> str:
+    """Build the native library if missing (requires g++ + make)."""
+    if not os.path.exists(_SO_PATH):
+        native_src = os.path.join(os.path.dirname(_NATIVE_DIR), "..", "native")
+        native_src = os.path.abspath(native_src)
+        if not os.path.isdir(native_src):
+            raise RuntimeError(
+                f"native library missing at {_SO_PATH} and no source tree found"
+            )
+        subprocess.run(["make", "-C", native_src, "-j"], check=True)
+    return _SO_PATH
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_native_built())
+        lib.tft_free.argtypes = [ctypes.c_char_p]
+        lib.tft_free.restype = None
+        lib.tft_lighthouse_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_lighthouse_address.argtypes = [ctypes.c_void_p]
+        lib.tft_lighthouse_address.restype = ctypes.c_void_p
+        lib.tft_lighthouse_port.argtypes = [ctypes.c_void_p]
+        lib.tft_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tft_lighthouse_free.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_new.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_manager_address.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_address.restype = ctypes.c_void_p
+        lib.tft_manager_port.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_free.argtypes = [ctypes.c_void_p]
+        lib.tft_client_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_client_free.argtypes = [ctypes.c_void_p]
+        lib.tft_client_call.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_kvstore_new.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_kvstore_port.argtypes = [ctypes.c_void_p]
+        lib.tft_kvstore_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tft_kvstore_free.argtypes = [ctypes.c_void_p]
+        lib.tft_quorum_compute.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_compute_quorum_results.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ]
+        _lib = lib
+    return _lib
+
+
+def _take_str(lib: ctypes.CDLL, ptr: "ctypes.c_char_p | int | None") -> str:
+    if not ptr:
+        return ""
+    try:
+        raw = ctypes.cast(ptr, ctypes.c_char_p).value or b""
+        return raw.decode("utf-8", errors="replace")
+    finally:
+        lib.tft_free(ctypes.cast(ptr, ctypes.c_char_p))
+
+
+def _raise_for_status(status: int, err: str, what: str) -> None:
+    if status == _OK:
+        return
+    msg = f"{what}: {err}" if err else what
+    if status == _TIMEOUT:
+        raise TimeoutError(msg)
+    if status == _NOT_FOUND:
+        raise LookupError(msg)
+    if status == _INVALID:
+        raise ValueError(msg)
+    raise RuntimeError(msg)
+
+
+def _ms(timeout: "float | timedelta") -> int:
+    if isinstance(timeout, timedelta):
+        return int(timeout.total_seconds() * 1000)
+    return int(timeout * 1000)
+
+
+# --------------------------------------------------------------------- types
+@dataclass
+class QuorumMember:
+    """Mirror of the wire QuorumMember (reference: proto/torchft.proto:37-47)."""
+
+    replica_id: str
+    address: str = ""
+    store_address: str = ""
+    step: int = 0
+    world_size: int = 1
+    shrink_only: bool = False
+    commit_failures: int = 0
+    data: str = ""
+
+    @staticmethod
+    def _from_json(d: dict) -> "QuorumMember":
+        return QuorumMember(
+            replica_id=d["replica_id"],
+            address=d.get("address", ""),
+            store_address=d.get("store_address", ""),
+            step=d.get("step", 0),
+            world_size=d.get("world_size", 1),
+            shrink_only=d.get("shrink_only", False),
+            commit_failures=d.get("commit_failures", 0),
+            data=d.get("data", ""),
+        )
+
+    def _to_json(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "address": self.address,
+            "store_address": self.store_address,
+            "step": self.step,
+            "world_size": self.world_size,
+            "shrink_only": self.shrink_only,
+            "commit_failures": self.commit_failures,
+            "data": self.data,
+        }
+
+
+@dataclass
+class Quorum:
+    quorum_id: int
+    participants: List[QuorumMember]
+    created_ms: int = 0
+
+    @staticmethod
+    def _from_json(d: dict) -> "Quorum":
+        return Quorum(
+            quorum_id=d["quorum_id"],
+            participants=[QuorumMember._from_json(p) for p in d["participants"]],
+            created_ms=d.get("created_ms", 0),
+        )
+
+
+@dataclass
+class QuorumResult:
+    """Per-rank manager quorum response (reference: proto ManagerQuorumResponse
+    + src/lib.rs:284-319)."""
+
+    quorum_id: int
+    replica_rank: int
+    replica_world_size: int
+    recover_src_manager_address: str
+    recover_src_replica_rank: Optional[int]
+    recover_dst_replica_ranks: List[int]
+    store_address: str
+    max_step: int
+    max_replica_rank: Optional[int]
+    max_world_size: int
+    heal: bool
+    commit_failures: int = 0
+    replica_ids: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def _from_json(d: dict) -> "QuorumResult":
+        return QuorumResult(
+            quorum_id=d["quorum_id"],
+            replica_rank=d["replica_rank"],
+            replica_world_size=d["replica_world_size"],
+            recover_src_manager_address=d.get("recover_src_manager_address", ""),
+            recover_src_replica_rank=d.get("recover_src_replica_rank"),
+            recover_dst_replica_ranks=list(d.get("recover_dst_replica_ranks", [])),
+            store_address=d.get("store_address", ""),
+            max_step=d.get("max_step", 0),
+            max_replica_rank=d.get("max_replica_rank"),
+            max_world_size=d.get("max_world_size", 0),
+            heal=d.get("heal", False),
+            commit_failures=d.get("commit_failures", 0),
+            replica_ids=list(d.get("replica_ids", [])),
+        )
+
+
+# ------------------------------------------------------------------- servers
+class LighthouseServer:
+    """In-process lighthouse quorum server (native C++).
+
+    Reference equivalent: ``LighthouseServer`` in src/lib.rs:609-671 backed by
+    src/lighthouse.rs. Also serves the HTML dashboard + ``/status`` JSON +
+    ``POST /replica/{id}/kill`` on the same port.
+    """
+
+    def __init__(
+        self,
+        bind: str = "0.0.0.0:0",
+        min_replicas: int = 1,
+        join_timeout_ms: int = 60000,
+        quorum_tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+    ) -> None:
+        lib = _load()
+        handle = ctypes.c_void_p()
+        err = ctypes.c_char_p()
+        status = lib.tft_lighthouse_new(
+            bind.encode(), min_replicas, join_timeout_ms, quorum_tick_ms,
+            heartbeat_timeout_ms, ctypes.byref(handle), ctypes.byref(err),
+        )
+        _raise_for_status(status, _take_str(lib, err), "lighthouse start failed")
+        self._lib = lib
+        self._handle = handle
+
+    def address(self) -> str:
+        return _take_str(self._lib, self._lib.tft_lighthouse_address(self._handle))
+
+    @property
+    def port(self) -> int:
+        return self._lib.tft_lighthouse_port(self._handle)
+
+    def shutdown(self) -> None:
+        if self._handle:
+            self._lib.tft_lighthouse_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.tft_lighthouse_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+class ManagerServer:
+    """Per-replica-group manager server (native C++).
+
+    Reference equivalent: ``ManagerServer`` in src/lib.rs:80-144 backed by
+    src/manager.rs.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str = "",
+        bind: str = "0.0.0.0:0",
+        store_addr: str = "",
+        world_size: int = 1,
+        heartbeat_interval: "float | timedelta" = 0.1,
+        connect_timeout: "float | timedelta" = 10.0,
+        quorum_retries: int = 0,
+    ) -> None:
+        lib = _load()
+        handle = ctypes.c_void_p()
+        err = ctypes.c_char_p()
+        opts = {
+            "replica_id": replica_id,
+            "lighthouse_addr": lighthouse_addr,
+            "hostname": hostname,
+            "bind": bind,
+            "store_addr": store_addr,
+            "world_size": world_size,
+            "heartbeat_interval_ms": _ms(heartbeat_interval),
+            "connect_timeout_ms": _ms(connect_timeout),
+            "quorum_retries": quorum_retries,
+        }
+        status = lib.tft_manager_new(
+            json.dumps(opts).encode(), ctypes.byref(handle), ctypes.byref(err)
+        )
+        _raise_for_status(status, _take_str(lib, err), "manager start failed")
+        self._lib = lib
+        self._handle = handle
+
+    def address(self) -> str:
+        return _take_str(self._lib, self._lib.tft_manager_address(self._handle))
+
+    @property
+    def port(self) -> int:
+        return self._lib.tft_manager_port(self._handle)
+
+    def shutdown(self) -> None:
+        if self._handle:
+            self._lib.tft_manager_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.tft_manager_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+class KvStoreServer:
+    """Rendezvous key-value store server (native C++; TCPStore equivalent)."""
+
+    def __init__(self, bind: str = "0.0.0.0:0") -> None:
+        lib = _load()
+        handle = ctypes.c_void_p()
+        err = ctypes.c_char_p()
+        status = lib.tft_kvstore_new(
+            bind.encode(), ctypes.byref(handle), ctypes.byref(err)
+        )
+        _raise_for_status(status, _take_str(lib, err), "kvstore start failed")
+        self._lib = lib
+        self._handle = handle
+
+    @property
+    def port(self) -> int:
+        return self._lib.tft_kvstore_port(self._handle)
+
+    def address(self) -> str:
+        import socket
+
+        return f"{socket.gethostname()}:{self.port}"
+
+    def shutdown(self) -> None:
+        if self._handle:
+            self._lib.tft_kvstore_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.tft_kvstore_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------- clients
+class _RawClient:
+    """Generic framed-JSON RPC client over the native transport."""
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
+        self._lib = _load()
+        handle = ctypes.c_void_p()
+        err = ctypes.c_char_p()
+        status = self._lib.tft_client_new(
+            addr.encode(), _ms(connect_timeout), ctypes.byref(handle),
+            ctypes.byref(err),
+        )
+        _raise_for_status(status, _take_str(self._lib, err), "client create failed")
+        self._handle = handle
+        self.addr = addr
+
+    def call(self, method: str, params: dict, timeout: "float | timedelta") -> dict:
+        result = ctypes.c_char_p()
+        err = ctypes.c_char_p()
+        status = self._lib.tft_client_call(
+            self._handle, method.encode(), json.dumps(params).encode(),
+            _ms(timeout), ctypes.byref(result), ctypes.byref(err),
+        )
+        err_s = _take_str(self._lib, err)
+        result_s = _take_str(self._lib, result)
+        _raise_for_status(status, err_s, f"{method} to {self.addr} failed")
+        return json.loads(result_s) if result_s else {}
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.tft_client_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+class LighthouseClient:
+    """Client for the lighthouse service (reference: src/lib.rs:486-594)."""
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
+        self._client = _RawClient(addr, connect_timeout)
+
+    def quorum(
+        self,
+        replica_id: str,
+        timeout: "float | timedelta",
+        address: str = "",
+        store_address: str = "",
+        step: int = 0,
+        world_size: int = 1,
+        shrink_only: bool = False,
+        data: Optional[Dict] = None,
+        commit_failures: int = 0,
+    ) -> Quorum:
+        member = QuorumMember(
+            replica_id=replica_id,
+            address=address,
+            store_address=store_address,
+            step=step,
+            world_size=world_size,
+            shrink_only=shrink_only,
+            commit_failures=commit_failures,
+            data=json.dumps(data) if data is not None else "",
+        )
+        resp = self._client.call("quorum", {"requester": member._to_json()}, timeout)
+        return Quorum._from_json(resp["quorum"])
+
+    def heartbeat(self, replica_id: str, timeout: "float | timedelta" = 5.0) -> None:
+        self._client.call("heartbeat", {"replica_id": replica_id}, timeout)
+
+    def status(self, timeout: "float | timedelta" = 5.0) -> dict:
+        return self._client.call("status", {}, timeout)
+
+
+class ManagerClient:
+    """Client for a replica group's manager service (reference: src/lib.rs:153-282)."""
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
+        self._client = _RawClient(addr, connect_timeout)
+
+    def _quorum(
+        self,
+        group_rank: int,
+        step: int,
+        checkpoint_metadata: str,
+        shrink_only: bool,
+        timeout: "float | timedelta",
+        init_sync: bool = True,
+        commit_failures: int = 0,
+    ) -> QuorumResult:
+        resp = self._client.call(
+            "quorum",
+            {
+                "group_rank": group_rank,
+                "step": step,
+                "checkpoint_metadata": checkpoint_metadata,
+                "shrink_only": shrink_only,
+                "init_sync": init_sync,
+                "commit_failures": commit_failures,
+            },
+            timeout,
+        )
+        return QuorumResult._from_json(resp)
+
+    def _checkpoint_metadata(self, rank: int, timeout: "float | timedelta") -> str:
+        resp = self._client.call("checkpoint_metadata", {"rank": rank}, timeout)
+        return resp["checkpoint_metadata"]
+
+    def should_commit(
+        self,
+        group_rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: "float | timedelta",
+    ) -> bool:
+        resp = self._client.call(
+            "should_commit",
+            {"group_rank": group_rank, "step": step, "should_commit": should_commit},
+            timeout,
+        )
+        return resp["should_commit"]
+
+    def kill(self, msg: str = "", timeout: "float | timedelta" = 5.0) -> None:
+        try:
+            self._client.call("kill", {"msg": msg}, timeout)
+        except (RuntimeError, TimeoutError):
+            pass  # the target exits without replying
+
+
+class KvClient:
+    """Client for the rendezvous KV store.
+
+    ``set`` values are arbitrary bytes ("b64:"-prefixed base64 on the wire);
+    ``add`` counters are stored by the server as plain decimal text — ``get``
+    handles both transparently.
+    """
+
+    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
+        self._client = _RawClient(addr, connect_timeout)
+
+    def set(self, key: str, value: "bytes | str", timeout: "float | timedelta" = 10.0) -> None:
+        import base64
+
+        if isinstance(value, str):
+            value = value.encode()
+        self._client.call(
+            "set",
+            {"key": key, "value": "b64:" + base64.b64encode(value).decode()},
+            timeout,
+        )
+
+    def get(
+        self, key: str, timeout: "float | timedelta" = 10.0, wait: bool = True
+    ) -> bytes:
+        import base64
+
+        resp = self._client.call("get", {"key": key, "wait": wait}, timeout)
+        value = resp["value"]
+        if value.startswith("b64:"):
+            return base64.b64decode(value[4:])
+        return value.encode()  # add() counter or other plain-text value
+
+    def add(self, key: str, amount: int, timeout: "float | timedelta" = 10.0) -> int:
+        return self._client.call("add", {"key": key, "amount": amount}, timeout)[
+            "value"
+        ]
+
+    def check(self, keys: List[str], timeout: "float | timedelta" = 10.0) -> bool:
+        return self._client.call("check", {"keys": keys}, timeout)["exists"]
+
+    def delete(self, key: str, timeout: "float | timedelta" = 10.0) -> bool:
+        return self._client.call("delete", {"key": key}, timeout)["deleted"]
+
+    def num_keys(self, timeout: "float | timedelta" = 10.0) -> int:
+        return self._client.call("num_keys", {}, timeout)["count"]
+
+
+# ----------------------------------------------------- pure logic (testing)
+def quorum_compute(state: dict, opts: dict) -> dict:
+    """Run the native lighthouse quorum computation on a synthetic state.
+
+    For unit tests (reference pattern: src/lighthouse.rs:627-1071).
+    """
+    lib = _load()
+    result = ctypes.c_char_p()
+    err = ctypes.c_char_p()
+    status = lib.tft_quorum_compute(
+        json.dumps(state).encode(), json.dumps(opts).encode(),
+        ctypes.byref(result), ctypes.byref(err),
+    )
+    err_s = _take_str(lib, err)
+    result_s = _take_str(lib, result)
+    _raise_for_status(status, err_s, "quorum_compute failed")
+    return json.loads(result_s)
+
+
+def compute_quorum_results(
+    replica_id: str, group_rank: int, quorum: dict, init_sync: bool = True
+) -> QuorumResult:
+    """Run the native per-rank recovery-assignment computation.
+
+    For unit tests (reference pattern: src/manager.rs:881-1108).
+    """
+    lib = _load()
+    result = ctypes.c_char_p()
+    err = ctypes.c_char_p()
+    status = lib.tft_compute_quorum_results(
+        replica_id.encode(), group_rank, json.dumps(quorum).encode(),
+        1 if init_sync else 0, ctypes.byref(result), ctypes.byref(err),
+    )
+    err_s = _take_str(lib, err)
+    result_s = _take_str(lib, result)
+    _raise_for_status(status, err_s, "compute_quorum_results failed")
+    return QuorumResult._from_json(json.loads(result_s))

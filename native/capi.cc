@@ -115,17 +115,19 @@ void tft_manager_shutdown(void* h) {
 void tft_manager_free(void* h) { delete static_cast<ManagerServer*>(h); }
 
 // ------------------------------------------------------------------- clients
-// Client handles are {addr, connect_timeout}; each call dials fresh (see
-// RpcClient docs) so one handle is safe from many threads.
+// Client handles own a persistent RpcClient: its cached keep-alive
+// connection is reused across calls (reconnecting if stale), and concurrent
+// calls from other threads transparently fall back to one-shot connections.
 struct ClientHandle {
-  std::string addr;
-  int64_t connect_timeout_ms;
+  RpcClient client;
+  ClientHandle(const char* addr, int64_t connect_timeout_ms)
+      : client(addr, Millis(connect_timeout_ms)) {}
 };
 
 int tft_client_new(const char* addr, int64_t connect_timeout_ms, void** out,
                    char** err) {
   TFT_TRY({
-    *out = new ClientHandle{addr, connect_timeout_ms};
+    *out = new ClientHandle(addr, connect_timeout_ms);
     return TFT_OK;
   })
 }
@@ -136,9 +138,8 @@ int tft_client_call(void* h, const char* method, const char* params_json,
                     int64_t timeout_ms, char** result, char** err) {
   TFT_TRY({
     auto* c = static_cast<ClientHandle*>(h);
-    RpcClient client(c->addr, Millis(c->connect_timeout_ms));
     Json params = Json::parse(params_json);
-    Json r = client.call(method, params, Millis(timeout_ms));
+    Json r = c->client.call(method, params, Millis(timeout_ms));
     if (result) *result = dup_str(r.dump());
     return TFT_OK;
   })

@@ -9,6 +9,25 @@ namespace {
 void log_info(const std::string& msg) {
   std::fprintf(stderr, "[lighthouse] %s\n", msg.c_str());
 }
+
+// HTML-escape untrusted strings (replica ids / addresses come from clients).
+// The reference's askama templates auto-escape; this hand-rolled page must
+// do the same to avoid stored XSS on the dashboard.
+std::string esc(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '&': out += "&amp;"; break;
+      case '<': out += "&lt;"; break;
+      case '>': out += "&gt;"; break;
+      case '"': out += "&quot;"; break;
+      case '\'': out += "&#39;"; break;
+      default: out.push_back(c);
+    }
+  }
+  return out;
+}
 }  // namespace
 
 Lighthouse::Lighthouse(const std::string& bind, LighthouseOpts opts)
@@ -184,8 +203,8 @@ std::string Lighthouse::status_html() {
   os << "<h2>heartbeats</h2><table><tr><th>replica</th><th>age (ms)</th>"
         "<th></th></tr>";
   for (const auto& [rid, age] : s.get("heartbeat_ages_ms").as_object()) {
-    os << "<tr><td>" << rid << "</td><td>" << age.as_int() << "</td><td>"
-       << "<form method=post action=\"/replica/" << rid
+    os << "<tr><td>" << esc(rid) << "</td><td>" << age.as_int() << "</td><td>"
+       << "<form method=post action=\"/replica/" << esc(rid)
        << "/kill\"><button>kill</button></form></td></tr>";
   }
   os << "</table>";
@@ -193,9 +212,9 @@ std::string Lighthouse::status_html() {
     os << "<h2>previous quorum</h2><table><tr><th>replica</th><th>step</th>"
           "<th>address</th></tr>";
     for (const auto& p : s.get("prev_quorum").get("participants").as_array()) {
-      os << "<tr><td>" << p.get("replica_id").as_string() << "</td><td>"
+      os << "<tr><td>" << esc(p.get("replica_id").as_string()) << "</td><td>"
          << p.get("step").as_int() << "</td><td>"
-         << p.get("address").as_string() << "</td></tr>";
+         << esc(p.get("address").as_string()) << "</td></tr>";
     }
     os << "</table>";
   }

@@ -38,13 +38,13 @@ void ManagerServer::shutdown() {
   quorum_cv_.notify_all();
   commit_cv_.notify_all();
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
-  std::vector<std::thread> workers;
+  std::vector<std::unique_ptr<WorkerSlot>> workers;
   {
     std::lock_guard<std::mutex> lk(mu_);
     workers.swap(quorum_workers_);
   }
-  for (auto& t : workers)
-    if (t.joinable()) t.join();
+  for (auto& w : workers)
+    if (w->thread.joinable()) w->thread.join();
   server_->shutdown();
 }
 
@@ -149,11 +149,26 @@ Json ManagerServer::rpc_quorum(const Json& params, TimePoint deadline) {
     participants_[group_rank] = member;
     waiting_gen = quorum_gen_;
 
-    if (static_cast<int64_t>(participants_.size()) == opts_.world_size) {
+    if (static_cast<int64_t>(participants_.size()) == opts_.world_size &&
+        running_.load()) {
       participants_.clear();
       Millis timeout(std::max<int64_t>(ms_until(deadline), 1));
-      quorum_workers_.emplace_back(
-          [this, member, timeout] { run_lighthouse_quorum(member, timeout); });
+      // Reap workers from completed rounds before spawning the next.
+      for (auto it = quorum_workers_.begin(); it != quorum_workers_.end();) {
+        if ((*it)->done.load()) {
+          if ((*it)->thread.joinable()) (*it)->thread.join();
+          it = quorum_workers_.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      auto slot = std::make_unique<WorkerSlot>();
+      WorkerSlot* slot_ptr = slot.get();
+      slot_ptr->thread = std::thread([this, member, timeout, slot_ptr] {
+        run_lighthouse_quorum(member, timeout);
+        slot_ptr->done.store(true);
+      });
+      quorum_workers_.push_back(std::move(slot));
     }
 
     bool got = quorum_cv_.wait_until(lk, deadline, [&] {

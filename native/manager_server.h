@@ -73,7 +73,13 @@ class ManagerServer {
   std::atomic<bool> running_{true};
   std::unique_ptr<RpcServer> server_;
   std::thread heartbeat_thread_;
-  std::vector<std::thread> quorum_workers_;
+  // One slot per in-flight lighthouse-quorum worker; finished slots are
+  // reaped when the next round spawns (and all joined at shutdown).
+  struct WorkerSlot {
+    std::thread thread;
+    std::atomic<bool> done{false};
+  };
+  std::vector<std::unique_ptr<WorkerSlot>> quorum_workers_;
   // Separate cached-connection clients so the 100ms heartbeat never queues
   // behind a long-blocking lighthouse quorum call.
   std::unique_ptr<RpcClient> heartbeat_client_;

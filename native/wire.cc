@@ -105,12 +105,22 @@ void RpcServer::serve_conn(std::shared_ptr<Socket> sock) {
   try {
     // Sniff: HTTP request lines start with an ASCII method ("GET ", "POST",
     // "HEAD"); our frames start with a 4-byte length whose first byte is
-    // 0x00 for any sane payload (<16 MiB).
+    // 0x00 for any sane payload (<16 MiB). A single peek can return fewer
+    // than 4 bytes under TCP segmentation, so keep peeking until we have
+    // them (the level-triggered wait inside peek() returns immediately while
+    // data is pending, hence the tiny sleep between retries).
     char probe[4] = {0};
-    size_t n = sock->peek(probe, 4, Clock::now() + Millis(30000));
-    bool is_http = n >= 3 && (memcmp(probe, "GET", 3) == 0 ||
-                              memcmp(probe, "POS", 3) == 0 ||
-                              memcmp(probe, "HEA", 3) == 0);
+    TimePoint sniff_deadline = Clock::now() + Millis(30000);
+    size_t n = 0;
+    while (n < 4) {
+      if (Clock::now() >= sniff_deadline)
+        throw std::runtime_error("sniff timed out");
+      n = sock->peek(probe, 4, sniff_deadline);
+      if (n < 4) std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+    bool is_http = memcmp(probe, "GET ", 4) == 0 ||
+                   memcmp(probe, "POST", 4) == 0 ||
+                   memcmp(probe, "HEAD", 4) == 0;
     if (is_http) {
       serve_http(*sock, "");
       return;

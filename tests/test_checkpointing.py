@@ -1,0 +1,194 @@
+"""Checkpoint transport tests (reference pattern: http_transport_test.py,
+pg_transport_test.py)."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import HTTPTransport, PGTransport
+from torchft_tpu.checkpointing._serialization import (
+    flatten_state,
+    split_chunks,
+    unflatten_state,
+)
+from torchft_tpu.coordination import KvStoreServer
+from torchft_tpu.process_group import ProcessGroupHost
+
+
+def make_state():
+    return {
+        "model": {
+            "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((4,), dtype=jnp.bfloat16),
+        },
+        "step": 7,
+        "opt": [np.full((2, 2), 3.0), {"lr": 0.1}],
+    }
+
+
+def assert_state_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        if hasattr(x, "shape"):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            assert x == y
+
+
+class TestSerialization:
+    def test_roundtrip(self):
+        state = make_state()
+        spec, payloads = flatten_state(state)
+        out = unflatten_state(spec, payloads)
+        assert_state_equal(state, out)
+
+    def test_bfloat16_preserved(self):
+        state = {"x": jnp.array([1.5, 2.5], dtype=jnp.bfloat16)}
+        spec, payloads = flatten_state(state)
+        out = unflatten_state(spec, payloads)
+        assert str(out["x"].dtype) == "bfloat16"
+
+    def test_split_chunks_balanced(self):
+        sizes = [100, 1, 1, 1, 50, 49]
+        chunks = split_chunks(sizes, 2)
+        assert sorted(i for c in chunks for i in c) == list(range(6))
+        totals = [sum(sizes[i] for i in c) for c in chunks]
+        assert max(totals) <= 102
+
+    def test_split_chunks_more_chunks_than_leaves(self):
+        chunks = split_chunks([10], 4)
+        assert sum(len(c) for c in chunks) == 1
+
+
+class TestHTTPTransport:
+    def test_send_recv_roundtrip(self):
+        src = HTTPTransport(timeout=10.0, num_chunks=3)
+        dst = HTTPTransport(timeout=10.0)
+        try:
+            state = make_state()
+            src.send_checkpoint([1], step=5, state_dict=state, timeout=10.0)
+            out = dst.recv_checkpoint(0, src.metadata(), step=5, timeout=10.0)
+            assert_state_equal(state, out)
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+    def test_wrong_step_rejected(self):
+        src = HTTPTransport(timeout=5.0)
+        dst = HTTPTransport(timeout=5.0)
+        try:
+            src.send_checkpoint([1], step=5, state_dict={"a": 1}, timeout=5.0)
+            with pytest.raises(Exception):
+                dst.recv_checkpoint(0, src.metadata(), step=6, timeout=5.0)
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+    def test_disallow_blocks_serving(self):
+        src = HTTPTransport(timeout=2.0)
+        dst = HTTPTransport(timeout=2.0)
+        try:
+            src.send_checkpoint([1], step=1, state_dict={"a": 1}, timeout=2.0)
+            src.disallow_checkpoint()
+            with pytest.raises(Exception):
+                dst.recv_checkpoint(0, src.metadata(), step=1, timeout=2.0)
+            # re-allow with new step
+            src.send_checkpoint([1], step=2, state_dict={"a": 2}, timeout=2.0)
+            out = dst.recv_checkpoint(0, src.metadata(), step=2, timeout=5.0)
+            assert out == {"a": 2}
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+    def test_concurrent_receivers(self):
+        src = HTTPTransport(timeout=10.0, num_chunks=2)
+        dst = HTTPTransport(timeout=10.0)
+        try:
+            state = make_state()
+            src.send_checkpoint([1, 2], step=3, state_dict=state, timeout=10.0)
+            with ThreadPoolExecutor(max_workers=3) as ex:
+                outs = list(
+                    ex.map(
+                        lambda _: dst.recv_checkpoint(
+                            0, src.metadata(), step=3, timeout=10.0
+                        ),
+                        range(3),
+                    )
+                )
+            for out in outs:
+                assert_state_equal(state, out)
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+
+class TestPGTransport:
+    def test_send_recv_over_host_pg(self):
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=10.0) for _ in range(2)]
+        try:
+            addr = f"127.0.0.1:{store.port}/ckpt"
+
+            def cfg(rank):
+                pgs[rank].configure(addr, rank, 2, quorum_id=9)
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(cfg, range(2)))
+
+            state = make_state()
+            sender = PGTransport(pgs[0], timeout=10.0)
+            receiver = PGTransport(pgs[1], timeout=10.0)
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fs = ex.submit(
+                    sender.send_checkpoint, [1], 4, state, 10.0
+                )
+                fr = ex.submit(
+                    receiver.recv_checkpoint, 0, "<pg_transport>", 4, 10.0
+                )
+                fs.result(timeout=30)
+                out = fr.result(timeout=30)
+            assert_state_equal(state, out)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()
+
+    def test_inplace_recv_places_on_template_sharding(self):
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=10.0) for _ in range(2)]
+        try:
+            addr = f"127.0.0.1:{store.port}/ckpt2"
+
+            def cfg(rank):
+                pgs[rank].configure(addr, rank, 2, quorum_id=10)
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(cfg, range(2)))
+
+            state = {"w": jnp.ones((4, 4), dtype=jnp.float32) * 5}
+            template = {"w": jnp.zeros((4, 4), dtype=jnp.float32)}
+            sender = PGTransport(pgs[0], timeout=10.0)
+            receiver = PGTransport(
+                pgs[1], timeout=10.0, state_dict_template=lambda: template
+            )
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fs = ex.submit(sender.send_checkpoint, [1], 0, state, 10.0)
+                fr = ex.submit(
+                    receiver.recv_checkpoint, 0, "<pg_transport>", 0, 10.0
+                )
+                fs.result(timeout=30)
+                out = fr.result(timeout=30)
+            assert isinstance(out["w"], jax.Array)
+            np.testing.assert_allclose(np.asarray(out["w"]), 5.0)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()

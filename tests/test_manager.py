@@ -1,0 +1,292 @@
+"""Manager unit tests with a mocked ManagerClient.
+
+Mirrors the reference manager_test.py (happy path, healing sync/async,
+not-enough-participants, allreduce errors, pg.errored propagation,
+fixed-with-spares, quorum failure, max_retries).
+"""
+
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import QuorumResult
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.process_group import ProcessGroupDummy, ReduceOp
+from torchft_tpu.work import Future
+
+
+def make_quorum(
+    quorum_id=1,
+    replica_rank=0,
+    replica_world_size=2,
+    heal=False,
+    max_step=0,
+    max_replica_rank=0,
+    max_world_size=2,
+    recover_src_replica_rank=None,
+    recover_dst_replica_ranks=(),
+):
+    return QuorumResult(
+        quorum_id=quorum_id,
+        replica_rank=replica_rank,
+        replica_world_size=replica_world_size,
+        recover_src_manager_address="mock://recover",
+        recover_src_replica_rank=recover_src_replica_rank,
+        recover_dst_replica_ranks=list(recover_dst_replica_ranks),
+        store_address="mockstore:1",
+        max_step=max_step,
+        max_replica_rank=max_replica_rank,
+        max_world_size=max_world_size,
+        heal=heal,
+        replica_ids=["a", "b"],
+    )
+
+
+def make_manager(pg=None, quorum=None, use_async_quorum=True, **kwargs):
+    """Build a Manager with all remote endpoints mocked out."""
+    pg = pg or ProcessGroupDummy()
+    transport = MagicMock()
+    transport.metadata.return_value = "mock://ckpt"
+    with (
+        patch("torchft_tpu.manager.ManagerServer") as server,
+        patch("torchft_tpu.manager.KvStoreServer") as store,
+        patch("torchft_tpu.manager.KvClient") as kv,
+        patch("torchft_tpu.manager.ManagerClient") as client_cls,
+    ):
+        server.return_value.address.return_value = "mock:1234"
+        store.return_value.port = 1
+        client = client_cls.return_value
+        if quorum is not None:
+            client._quorum.return_value = quorum
+        client.should_commit.side_effect = lambda rank, step, ok, timeout: ok
+        m = Manager(
+            pg=pg,
+            load_state_dict=kwargs.pop("load_state_dict", MagicMock()),
+            state_dict=kwargs.pop("state_dict", lambda: {"w": np.ones(2)}),
+            min_replica_size=kwargs.pop("min_replica_size", 2),
+            use_async_quorum=use_async_quorum,
+            replica_id="test",
+            lighthouse_addr="mock:1",
+            checkpoint_transport=transport,
+            timeout=kwargs.pop("timeout", 5.0),
+            **kwargs,
+        )
+        m._test_client = client
+        m._test_transport = transport
+        return m
+
+
+class TestQuorumHappyPath:
+    def test_quorum_and_commit(self):
+        m = make_manager(quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.num_participants() == 2
+        assert m.is_participating()
+        assert m.participating_rank() == 0
+        assert m.should_commit()
+        assert m.current_step() == 1
+        assert m.batches_committed() == 2
+
+    def test_allreduce_avg(self):
+        m = make_manager(quorum=make_quorum())
+        m.start_quorum()
+        grads = {"w": np.full((3,), 4.0, dtype=np.float32)}
+        out = m.allreduce(grads).get_future().wait(timeout=10)
+        # dummy PG world 1: sum == input, then divided by num_participants=2
+        np.testing.assert_allclose(out["w"], 2.0)
+
+    def test_allreduce_sum_no_normalize(self):
+        m = make_manager(quorum=make_quorum())
+        m.start_quorum()
+        out = (
+            m.allreduce({"w": np.ones(2)}, reduce_op=ReduceOp.SUM)
+            .get_future()
+            .wait(timeout=10)
+        )
+        np.testing.assert_allclose(out["w"], 1.0)
+
+    def test_pg_configured_once_per_quorum_id(self):
+        pg = ProcessGroupDummy()
+        m = make_manager(pg=pg, quorum=make_quorum(quorum_id=5))
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.configure_count == 1
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.configure_count == 1  # same quorum id -> no reconfigure
+        m._test_client._quorum.return_value = make_quorum(quorum_id=6)
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.configure_count == 2
+
+
+class TestHealing:
+    def test_async_heal_is_nonparticipating(self):
+        q = make_quorum(
+            heal=True,
+            max_step=3,
+            max_replica_rank=None,
+            max_world_size=1,
+            recover_src_replica_rank=1,
+        )
+        m = make_manager(quorum=q, min_replica_size=1)
+        m._test_transport.recv_checkpoint.return_value = {
+            "user": {"default": {"w": np.zeros(2)}},
+            "torchft": {"step": 3, "batches_committed": 6},
+        }
+        with patch("torchft_tpu.manager.ManagerClient") as mc:
+            mc.return_value._checkpoint_metadata.return_value = "mock://peer"
+            m.start_quorum()
+            m.wait_quorum()
+        assert m._healing
+        assert not m.is_participating()
+        assert m.num_participants() == 1
+        # healing replica contributes zeros
+        out = m.allreduce({"w": np.full(2, 8.0, dtype=np.float32)}).get_future().wait(10)
+        np.testing.assert_allclose(out["w"], 0.0)
+        # commit applies the pending state dict and restores step
+        assert m.should_commit()
+        assert m.current_step() == 4  # healed to 3, +1 on commit
+
+    def test_sync_quorum_applies_state_eagerly(self):
+        q = make_quorum(
+            heal=True,
+            max_step=2,
+            max_replica_rank=None,
+            max_world_size=1,
+            recover_src_replica_rank=1,
+        )
+        load_fn = MagicMock()
+        m = make_manager(
+            quorum=q, min_replica_size=1, use_async_quorum=False, load_state_dict=load_fn
+        )
+        m._test_transport.recv_checkpoint.return_value = {
+            "user": {"default": {"w": np.ones(2)}},
+            "torchft": {"step": 2, "batches_committed": 4},
+        }
+        with patch("torchft_tpu.manager.ManagerClient") as mc:
+            mc.return_value._checkpoint_metadata.return_value = "mock://peer"
+            m.start_quorum()
+        assert not m._healing  # already applied
+        load_fn.assert_called_once()
+        assert m.current_step() == 2
+        assert m.is_participating()  # sync mode participates after heal
+
+    def test_send_checkpoint_to_recovering_peers(self):
+        q = make_quorum(recover_dst_replica_ranks=[1])
+        m = make_manager(quorum=q)
+        m.start_quorum()
+        m.wait_quorum()
+        m._test_transport.send_checkpoint.assert_called_once()
+        kwargs = m._test_transport.send_checkpoint.call_args.kwargs
+        assert kwargs["dst_ranks"] == [1]
+        assert "user" in kwargs["state_dict"]
+
+
+class TestErrors:
+    def test_allreduce_error_returns_zeros_and_blocks_commit(self):
+        pg = MagicMock(wraps=ProcessGroupDummy())
+        pg.errored.return_value = None
+        pg.allreduce.side_effect = RuntimeError("collective failed")
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        out = m.allreduce({"w": np.full(2, 5.0, dtype=np.float32)}).get_future().wait(10)
+        np.testing.assert_allclose(out["w"], 0.0)
+        assert m.errored() is not None
+        assert not m.should_commit()
+        assert m.current_step() == 0
+
+    def test_errored_fast_path_skips_collective(self):
+        pg = MagicMock(wraps=ProcessGroupDummy())
+        pg.errored.return_value = None
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.report_error(RuntimeError("earlier error"))
+        out = m.allreduce({"w": np.ones(2, dtype=np.float32)}).get_future().wait(10)
+        np.testing.assert_allclose(out["w"], 0.0)
+        pg.allreduce.assert_not_called()
+
+    def test_pg_errored_propagates_at_commit(self):
+        pg = ProcessGroupDummy()
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        with patch.object(pg, "errored", return_value=RuntimeError("pg dead")):
+            assert not m.should_commit()
+
+    def test_quorum_rpc_failure_marks_errored(self):
+        m = make_manager()
+        m._test_client._quorum.side_effect = TimeoutError("lighthouse down")
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.errored() is not None
+        assert not m.should_commit()
+
+    def test_not_enough_participants(self):
+        q = make_quorum(max_world_size=1, replica_world_size=1)
+        m = make_manager(quorum=q, min_replica_size=2)
+        m.start_quorum()
+        assert not m.should_commit()
+
+    def test_max_retries_raises(self):
+        q = make_quorum(max_world_size=1, replica_world_size=1)
+        m = make_manager(quorum=q, min_replica_size=2, max_retries=1)
+        m.start_quorum()
+        assert not m.should_commit()  # failure 1 (== max_retries, tolerated)
+        m.start_quorum()
+        with pytest.raises(RuntimeError, match="max_retries"):
+            m.should_commit()  # failure 2 > max_retries
+
+    def test_commit_failures_reported_to_quorum(self):
+        q = make_quorum(max_world_size=1, replica_world_size=1)
+        m = make_manager(quorum=q, min_replica_size=2)
+        m.start_quorum()
+        assert not m.should_commit()
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._test_client._quorum.call_args.kwargs["commit_failures"] == 1
+
+
+class TestWorldSizeModes:
+    def test_fixed_with_spares_clamps_world(self):
+        q = make_quorum(
+            replica_rank=2, replica_world_size=3, max_replica_rank=2, max_world_size=3
+        )
+        m = make_manager(quorum=q, min_replica_size=2,
+                         world_size_mode=WorldSizeMode.FIXED_WITH_SPARES)
+        m.start_quorum()
+        assert m.num_participants() == 2
+        assert m.participating_rank() is None  # rank 2 is a spare
+        assert not m.is_participating()
+
+    def test_fixed_with_spares_participant(self):
+        q = make_quorum(
+            replica_rank=1, replica_world_size=3, max_replica_rank=1, max_world_size=3
+        )
+        m = make_manager(quorum=q, min_replica_size=2,
+                         world_size_mode=WorldSizeMode.FIXED_WITH_SPARES)
+        m.start_quorum()
+        assert m.num_participants() == 2
+        assert m.participating_rank() == 1
+
+
+class TestStateDict:
+    def test_state_dict_roundtrip(self):
+        m = make_manager(quorum=make_quorum())
+        m.start_quorum()
+        assert m.should_commit()
+        sd = m.state_dict()
+        assert sd == {"step": 1, "batches_committed": 2}
+        m2 = make_manager(quorum=make_quorum())
+        m2.load_state_dict(sd)
+        assert m2.current_step() == 1
+        assert m2.batches_committed() == 2
+
+    def test_register_state_dict_fn_included_in_manager_state(self):
+        m = make_manager(quorum=make_quorum())
+        m.register_state_dict_fn("extra", MagicMock(), lambda: {"x": 1})
+        state = m._manager_state_dict()
+        assert set(state["user"].keys()) == {"default", "extra"}
+        assert state["torchft"] == {"step": 0, "batches_committed": 0}

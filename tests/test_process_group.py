@@ -1,0 +1,263 @@
+"""ProcessGroup tests (reference pattern: process_group_test.py).
+
+Replica groups are threads sharing one KV store, like the reference's
+MultiPgBaseTest (process_group_test.py:792-891), including the resiliency
+harness: crash a rank, expect errors on survivors, reconfigure, verify the
+collective works again (:894-950).
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import KvStoreServer
+from torchft_tpu.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+    ProcessGroupHost,
+    ReduceOp,
+)
+
+
+@pytest.fixture()
+def store():
+    s = KvStoreServer("127.0.0.1:0")
+    yield s
+    s.shutdown()
+
+
+def run_parallel(world, fn):
+    """Run fn(rank) in `world` threads, return results by rank, re-raising."""
+    with ThreadPoolExecutor(max_workers=world) as ex:
+        futs = [ex.submit(fn, r) for r in range(world)]
+        return [f.result(timeout=60) for f in futs]
+
+
+def make_pgs(store, world, quorum_id=1, timeout=10.0):
+    pgs = [ProcessGroupHost(timeout=timeout) for _ in range(world)]
+    store_addr = f"127.0.0.1:{store.port}/test"
+
+    def cfg(rank):
+        pgs[rank].configure(store_addr, rank, world, quorum_id=quorum_id)
+
+    run_parallel(world, cfg)
+    return pgs
+
+
+class TestProcessGroupDummy:
+    def test_collectives_identity(self):
+        pg = ProcessGroupDummy()
+        x = np.arange(4.0)
+        assert pg.size() == 1
+        np.testing.assert_array_equal(pg.allreduce([x]).get_future().wait()[0], x)
+        np.testing.assert_array_equal(pg.broadcast([x]).get_future().wait()[0], x)
+        assert pg.allgather([x]).get_future().wait()[0][0] is x
+
+
+class TestProcessGroupHost:
+    WORLD = 3
+
+    def test_allreduce_sum_and_avg(self, store):
+        pgs = make_pgs(store, self.WORLD)
+
+        def step(rank):
+            x = np.full((4,), float(rank + 1), dtype=np.float32)
+            s = pgs[rank].allreduce([x], ReduceOp.SUM).get_future().wait()[0]
+            a = pgs[rank].allreduce([x], ReduceOp.AVG).get_future().wait()[0]
+            return s, a
+
+        for s, a in run_parallel(self.WORLD, step):
+            np.testing.assert_allclose(s, np.full((4,), 6.0))
+            np.testing.assert_allclose(a, np.full((4,), 2.0))
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allreduce_max_multiple_tensors(self, store):
+        pgs = make_pgs(store, self.WORLD)
+
+        def step(rank):
+            xs = [np.array([float(rank)]), np.array([float(-rank)])]
+            return pgs[rank].allreduce(xs, ReduceOp.MAX).get_future().wait()
+
+        for out in run_parallel(self.WORLD, step):
+            np.testing.assert_allclose(out[0], [2.0])
+            np.testing.assert_allclose(out[1], [0.0])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_broadcast(self, store):
+        pgs = make_pgs(store, self.WORLD)
+
+        def step(rank):
+            x = np.full((2,), float(rank), dtype=np.float32)
+            return pgs[rank].broadcast([x], root=1).get_future().wait()[0]
+
+        for out in run_parallel(self.WORLD, step):
+            np.testing.assert_allclose(out, np.full((2,), 1.0))
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allgather(self, store):
+        pgs = make_pgs(store, self.WORLD)
+
+        def step(rank):
+            x = np.array([float(rank)])
+            return pgs[rank].allgather([x]).get_future().wait()
+
+        for out in run_parallel(self.WORLD, step):
+            assert len(out) == self.WORLD
+            for r in range(self.WORLD):
+                np.testing.assert_allclose(out[r][0], [float(r)])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_reduce_scatter(self, store):
+        pgs = make_pgs(store, self.WORLD)
+
+        def step(rank):
+            chunks = [[np.array([float(rank + r)])] for r in range(self.WORLD)]
+            return pgs[rank].reduce_scatter(chunks).get_future().wait()
+
+        outs = run_parallel(self.WORLD, step)
+        for r, out in enumerate(outs):
+            # sum over ranks of (rank + r)
+            expected = sum(float(rank + r) for rank in range(self.WORLD))
+            np.testing.assert_allclose(out[0], [expected])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_alltoall(self, store):
+        pgs = make_pgs(store, self.WORLD)
+
+        def step(rank):
+            chunks = [np.array([rank * 10.0 + r]) for r in range(self.WORLD)]
+            return pgs[rank].alltoall(chunks).get_future().wait()
+
+        outs = run_parallel(self.WORLD, step)
+        for r, out in enumerate(outs):
+            for src in range(self.WORLD):
+                np.testing.assert_allclose(out[src], [src * 10.0 + r])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_send_recv(self, store):
+        pgs = make_pgs(store, 2)
+
+        def step(rank):
+            if rank == 0:
+                pgs[0].send([np.array([42.0])], dst=1, tag=7).wait()
+                return None
+            return pgs[1].recv(src=0, tag=7).get_future().wait()
+
+        outs = run_parallel(2, step)
+        np.testing.assert_allclose(outs[1][0], [42.0])
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_barrier(self, store):
+        pgs = make_pgs(store, self.WORLD)
+        run_parallel(self.WORLD, lambda r: pgs[r].barrier().wait())
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_world_size_one_noop(self, store):
+        (pg,) = make_pgs(store, 1)
+        x = np.arange(3.0)
+        np.testing.assert_allclose(
+            pg.allreduce([x], ReduceOp.AVG).get_future().wait()[0], x
+        )
+        pg.shutdown()
+
+    def test_resiliency_crash_and_reconfigure(self, store):
+        """Crash the last rank mid-life; survivors must observe an error and
+        then recover after reconfiguring to a smaller world."""
+        world = 3
+        pgs = make_pgs(store, world, quorum_id=1, timeout=3.0)
+
+        # Everyone agrees the mesh works.
+        run_parallel(world, lambda r: pgs[r].barrier().wait())
+
+        pgs[2].abort()  # crash
+
+        def survivor_step(rank):
+            if rank == 2:
+                return "crashed"
+            x = np.array([1.0])
+            with pytest.raises(Exception):
+                pgs[rank].allreduce([x]).get_future().wait(timeout=10)
+            return "errored"
+
+        assert run_parallel(world, survivor_step) == ["errored", "errored", "crashed"]
+        assert pgs[0].errored() is not None
+
+        # Reconfigure survivors under a new quorum id with world=2.
+        def recfg(rank):
+            pgs[rank].configure(f"127.0.0.1:{store.port}/test", rank, 2, quorum_id=2)
+            x = np.array([float(rank + 1)])
+            return pgs[rank].allreduce([x]).get_future().wait()[0]
+
+        outs = run_parallel(2, recfg)
+        for out in outs:
+            np.testing.assert_allclose(out, [3.0])
+        assert pgs[0].errored() is None
+        for pg in pgs[:2]:
+            pg.shutdown()
+
+    def test_timeout_aborts(self, store):
+        """A collective that can't complete (partner never joins it) aborts
+        after the timeout instead of hanging forever."""
+        pgs = make_pgs(store, 2, timeout=1.0)
+
+        # Only rank 0 issues the collective; rank 1 stays silent.
+        with pytest.raises(Exception):
+            pgs[0].allreduce([np.array([1.0])]).get_future().wait(timeout=15)
+        assert pgs[0].errored() is not None
+        for pg in pgs:
+            pg.shutdown()
+
+
+class TestWrappers:
+    def test_error_swallowing(self, store):
+        inner = ProcessGroupDummy()
+        pg = ErrorSwallowingProcessGroupWrapper(inner)
+        x = np.array([5.0])
+        out = pg.allreduce([x]).get_future().wait()
+        np.testing.assert_allclose(out[0], [5.0])
+        assert pg.error() is None
+
+        pg.report_error(RuntimeError("injected"))
+        # After an error every op resolves to its input (identity).
+        out = pg.allreduce([np.array([7.0])]).get_future().wait()
+        np.testing.assert_allclose(out[0], [7.0])
+
+        # Reconfigure clears the error.
+        pg.configure("ignored:0/x", 0, 1)
+        assert pg.error() is None
+
+    def test_fake_wrapper_injects_future_error(self):
+        pg = FakeProcessGroupWrapper(ProcessGroupDummy())
+        pg.report_future_error(RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="boom"):
+            pg.allreduce([np.array([1.0])]).get_future().wait()
+        # next op is clean
+        pg.allreduce([np.array([1.0])]).get_future().wait()
+
+    def test_fake_wrapper_injects_configure_error(self):
+        pg = FakeProcessGroupWrapper(ProcessGroupDummy())
+        pg.report_configure_error(RuntimeError("cfg boom"))
+        with pytest.raises(RuntimeError, match="cfg boom"):
+            pg.configure("ignored:0/x", 0, 1)
+        pg.configure("ignored:0/x", 0, 1)  # clean afterwards
+
+    def test_error_swallowing_over_fake(self):
+        """Composition used by integration tests: injected future error is
+        swallowed into the default value."""
+        fake = FakeProcessGroupWrapper(ProcessGroupDummy())
+        pg = ErrorSwallowingProcessGroupWrapper(fake)
+        fake.report_future_error(RuntimeError("boom"))
+        out = pg.allreduce([np.array([3.0])]).get_future().wait()
+        np.testing.assert_allclose(out[0], [3.0])
+        assert pg.error() is not None

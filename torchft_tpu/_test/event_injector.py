@@ -1,0 +1,79 @@
+"""Fault-injection scheduling for integration tests.
+
+Mirror of the reference EventInjector (manager_integ_test.py:88-166):
+events fire at a given (replica, step) — process failure, allreduce future
+failure, or a barrier.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Optional, Tuple
+
+from torchft_tpu.process_group import FakeProcessGroupWrapper
+
+__all__ = ["EventInjector", "InjectedFailure", "EventKind"]
+
+
+class InjectedFailure(Exception):
+    """Simulated process crash."""
+
+
+class EventKind(Enum):
+    FAILURE = "failure"
+    ALLREDUCE_FAILURE = "allreduce_failure"
+    BARRIER = "barrier"
+
+
+@dataclass
+class _Event:
+    kind: EventKind
+    fired: bool = False
+
+
+class EventInjector:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: Dict[Tuple[int, int], _Event] = {}
+        self._barrier: Optional[threading.Barrier] = None
+        self.count = 0
+
+    def fail_at(self, replica: int, step: int) -> "EventInjector":
+        with self._lock:
+            self._events[(replica, step)] = _Event(EventKind.FAILURE)
+        return self
+
+    def fail_allreduce_at(self, replica: int, step: int) -> "EventInjector":
+        with self._lock:
+            self._events[(replica, step)] = _Event(EventKind.ALLREDUCE_FAILURE)
+        return self
+
+    def barrier_at(self, replica: int, step: int, parties: int) -> "EventInjector":
+        with self._lock:
+            self._events[(replica, step)] = _Event(EventKind.BARRIER)
+            self._barrier = threading.Barrier(parties)
+        return self
+
+    def check(
+        self, replica: int, step: int, pg: Optional[FakeProcessGroupWrapper] = None
+    ) -> None:
+        """Call once per (replica, step); fires at most once per event."""
+        with self._lock:
+            event = self._events.get((replica, step))
+            if event is None or event.fired:
+                return
+            event.fired = True
+            self.count += 1
+            kind = event.kind
+        if kind == EventKind.FAILURE:
+            raise InjectedFailure(f"injected failure replica={replica} step={step}")
+        if kind == EventKind.ALLREDUCE_FAILURE:
+            assert pg is not None, "allreduce failure needs the fake PG"
+            pg.report_future_error(
+                RuntimeError(f"injected allreduce failure replica={replica} step={step}")
+            )
+        if kind == EventKind.BARRIER:
+            assert self._barrier is not None
+            self._barrier.wait()

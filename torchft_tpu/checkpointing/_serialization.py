@@ -1,0 +1,120 @@
+"""Pytree (de)serialization for checkpoint transports.
+
+Role-equivalent of the reference's streaming torch.save/load
+(torchft/checkpointing/_serialization.py:14-39) but for JAX pytrees: the tree
+structure and per-leaf metadata travel as a pickled spec; array payloads are
+raw little-endian buffers that can be split into chunks and fetched in
+parallel (reference chunking: http_transport.py:287-298).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "TensorMeta",
+    "TreeSpecPayload",
+    "flatten_state",
+    "unflatten_state",
+    "leaf_to_bytes",
+    "leaf_from_bytes",
+    "split_chunks",
+]
+
+
+@dataclass
+class TensorMeta:
+    """Per-leaf metadata (reference: pg_transport.py:32-59 _TensorMeta).
+
+    ``sharding`` optionally carries a jax.sharding description so the
+    receiver can device_put straight back to the right layout.
+    """
+
+    dtype: str
+    shape: Tuple[int, ...]
+    nbytes: int
+    kind: str = "array"  # "array" | "pickled" (non-array leaf)
+
+
+@dataclass
+class TreeSpecPayload:
+    """Pickled header: tree structure + leaf metadata."""
+
+    treedef_bytes: bytes
+    leaves: List[TensorMeta] = field(default_factory=list)
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, np.ndarray) or type(x).__module__.startswith("jax")
+
+
+def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[bytes]]:
+    """Flatten a pytree into (spec, per-leaf payloads).
+
+    Array leaves (numpy or jax) are staged to host and serialized as raw
+    buffers; other leaves are pickled.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    metas: List[TensorMeta] = []
+    payloads: List[bytes] = []
+    for leaf in leaves:
+        if _is_array(leaf):
+            host = np.asarray(leaf)
+            buf = host.tobytes()
+            metas.append(
+                TensorMeta(
+                    dtype=str(host.dtype), shape=tuple(host.shape), nbytes=len(buf)
+                )
+            )
+            payloads.append(buf)
+        else:
+            buf = pickle.dumps(leaf)
+            metas.append(
+                TensorMeta(dtype="", shape=(), nbytes=len(buf), kind="pickled")
+            )
+            payloads.append(buf)
+    spec = TreeSpecPayload(treedef_bytes=pickle.dumps(treedef), leaves=metas)
+    return spec, payloads
+
+
+def leaf_to_bytes(leaf: Any) -> bytes:
+    if _is_array(leaf):
+        return np.asarray(leaf).tobytes()
+    return pickle.dumps(leaf)
+
+
+def leaf_from_bytes(meta: TensorMeta, buf: bytes) -> Any:
+    if meta.kind == "pickled":
+        return pickle.loads(buf)
+    arr = np.frombuffer(buf, dtype=np.dtype(meta.dtype)).reshape(meta.shape)
+    return arr.copy()  # own the memory (buf may be a transient view)
+
+
+def unflatten_state(spec: TreeSpecPayload, payloads: Sequence[bytes]) -> Any:
+    import jax
+
+    treedef = pickle.loads(spec.treedef_bytes)
+    leaves = [leaf_from_bytes(m, b) for m, b in zip(spec.leaves, payloads)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def split_chunks(
+    payload_sizes: Sequence[int], num_chunks: int
+) -> List[List[int]]:
+    """Greedy size-balanced assignment of leaf indices to chunks."""
+    num_chunks = max(1, min(num_chunks, max(len(payload_sizes), 1)))
+    chunks: List[List[int]] = [[] for _ in range(num_chunks)]
+    sizes = [0] * num_chunks
+    order = sorted(range(len(payload_sizes)), key=lambda i: -payload_sizes[i])
+    for i in order:
+        j = min(range(num_chunks), key=lambda k: sizes[k])
+        chunks[j].append(i)
+        sizes[j] += payload_sizes[i]
+    return chunks

@@ -1,0 +1,185 @@
+"""HTTP checkpoint transport: the default live-recovery path.
+
+Design mirror of the reference HTTPTransport
+(torchft/checkpointing/http_transport.py:38-266): a threaded HTTP server
+serving ``/checkpoint/{step}/{metadata|chunk_{i}}``, gated by an RWLock so
+serving can be disallowed while the optimizer mutates state; receivers fetch
+chunks in parallel and reassemble the pytree.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing._serialization import (
+    TreeSpecPayload,
+    flatten_state,
+    split_chunks,
+    unflatten_state,
+)
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["HTTPTransport"]
+
+
+def _to_seconds(timeout: "float | timedelta") -> float:
+    return timeout.total_seconds() if isinstance(timeout, timedelta) else float(timeout)
+
+
+class HTTPTransport(CheckpointTransport[Any]):
+    """Serve checkpoints over HTTP; receive with parallel chunk fetch.
+
+    ``num_chunks=0`` serves everything as one chunk.
+    """
+
+    def __init__(self, timeout: "float | timedelta" = 60.0, num_chunks: int = 0) -> None:
+        self._timeout = _to_seconds(timeout)
+        self._num_chunks = num_chunks
+        # Write-locked whenever there is NO servable checkpoint; readers are
+        # in-flight HTTP requests (reference: http_transport.py:181-202).
+        self._state_lock = RWLock(timeout=self._timeout)
+        self._state_lock.w_acquire()
+        self._have_state = False
+
+        self._step: Optional[int] = None
+        self._spec: Optional[TreeSpecPayload] = None
+        self._chunks: Optional[List[bytes]] = None  # pre-assembled chunk bodies
+
+        transport = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("http_transport: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                try:
+                    parts = self.path.strip("/").split("/")
+                    # /checkpoint/{step}/{what}
+                    if len(parts) != 3 or parts[0] != "checkpoint":
+                        self.send_error(404, "unknown path")
+                        return
+                    step = int(parts[1])
+                    what = parts[2]
+                    try:
+                        with transport._state_lock.r_lock(timeout=transport._timeout):
+                            if transport._step != step:
+                                self.send_error(
+                                    400,
+                                    f"serving step {transport._step}, asked {step}",
+                                )
+                                return
+                            body = transport._body_for(what)
+                    except TimeoutError:
+                        self.send_error(503, "checkpoint not available (locked)")
+                        return
+                    if body is None:
+                        self.send_error(404, f"unknown resource {what}")
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("http_transport handler failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
+        self._server.daemon_threads = True
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="torchft_http_ckpt"
+        )
+        self._serve_thread.start()
+
+    # -- serving side -----------------------------------------------------
+    def _body_for(self, what: str) -> Optional[bytes]:
+        assert self._spec is not None and self._chunks is not None
+        if what == "metadata":
+            return pickle.dumps((self._spec, len(self._chunks)))
+        if what.startswith("chunk_"):
+            i = int(what[len("chunk_"):])
+            if 0 <= i < len(self._chunks):
+                return self._chunks[i]
+        return None
+
+    def metadata(self) -> str:
+        host = socket.gethostname()
+        port = self._server.server_address[1]
+        return f"http://{host}:{port}"
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: Any, timeout
+    ) -> None:
+        """Stage the state (host copy) and open the serving window.
+
+        HTTP is pull-based: "send" = make available to ``dst_ranks`` until
+        ``disallow_checkpoint`` re-locks (reference: http_transport.py:219-241).
+        """
+        spec, payloads = flatten_state(state_dict)
+        num = self._num_chunks or 1
+        assignments = split_chunks([len(p) for p in payloads], num)
+        chunks = [
+            pickle.dumps([(i, payloads[i]) for i in idxs]) for idxs in assignments
+        ]
+        self._step = step
+        self._spec = spec
+        self._chunks = chunks
+        if not self._have_state:
+            self._have_state = True
+            self._state_lock.w_release()
+
+    def disallow_checkpoint(self) -> None:
+        if self._have_state:
+            if not self._state_lock.w_acquire(timeout=self._timeout):
+                raise TimeoutError(
+                    "timed out waiting for in-flight checkpoint reads to finish"
+                )
+            self._have_state = False
+            self._spec = None
+            self._chunks = None
+            self._step = None
+
+    # -- receiving side ---------------------------------------------------
+    def recv_checkpoint(self, src_rank: int, metadata: str, step: int, timeout) -> Any:
+        timeout_s = _to_seconds(timeout)
+        base = f"{metadata}/checkpoint/{step}"
+
+        def fetch(url: str) -> bytes:
+            with urllib.request.urlopen(url, timeout=timeout_s) as r:
+                return r.read()
+
+        spec, num_chunks = pickle.loads(fetch(f"{base}/metadata"))
+        payloads: List[Optional[bytes]] = [None] * len(spec.leaves)
+        with ThreadPoolExecutor(max_workers=max(1, min(num_chunks, 8))) as ex:
+            bodies = list(
+                ex.map(lambda i: fetch(f"{base}/chunk_{i}"), range(num_chunks))
+            )
+        for body in bodies:
+            for leaf_idx, buf in pickle.loads(body):
+                payloads[leaf_idx] = buf
+        missing = [i for i, p in enumerate(payloads) if p is None]
+        if missing:
+            raise RuntimeError(f"checkpoint chunks missing leaves {missing}")
+        return unflatten_state(spec, payloads)  # type: ignore[arg-type]
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if wait:
+            self._serve_thread.join(timeout=5)

@@ -1,0 +1,41 @@
+"""Checkpoint transport interface.
+
+Mirror of the reference CheckpointTransport ABC
+(torchft/checkpointing/transport.py:14-68): live-recovery state streaming
+between replica groups. ``state_dict`` here is any JAX pytree.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from datetime import timedelta
+from typing import Any, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["CheckpointTransport"]
+
+
+class CheckpointTransport(ABC, Generic[T]):
+    @abstractmethod
+    def metadata(self) -> str:
+        """Opaque string other replicas use to connect to this transport
+        (fetched via the manager's checkpoint_metadata RPC)."""
+
+    @abstractmethod
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: "float | timedelta"
+    ) -> None:
+        """Serve/send ``state_dict`` for ``step`` to the given replica ranks."""
+
+    def disallow_checkpoint(self) -> None:
+        """Stop serving (the state is about to be mutated by the optimizer)."""
+
+    @abstractmethod
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: "float | timedelta"
+    ) -> T:
+        """Fetch the state for ``step`` from ``src_rank``."""
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Tear down (terminal)."""

@@ -40,7 +40,11 @@ _OK, _TIMEOUT, _ERROR, _NOT_FOUND, _INVALID, _UNAVAILABLE = range(6)
 
 
 def ensure_native_built() -> str:
-    """Build the native library if missing (requires g++ + make)."""
+    """Build the native library if missing (requires g++ + make).
+
+    Serialized across processes with a file lock so a multi-process launch on
+    a fresh checkout doesn't race the build.
+    """
     if not os.path.exists(_SO_PATH):
         native_src = os.path.join(os.path.dirname(_NATIVE_DIR), "..", "native")
         native_src = os.path.abspath(native_src)
@@ -48,7 +52,17 @@ def ensure_native_built() -> str:
             raise RuntimeError(
                 f"native library missing at {_SO_PATH} and no source tree found"
             )
-        subprocess.run(["make", "-C", native_src, "-j"], check=True)
+        import fcntl
+
+        os.makedirs(_NATIVE_DIR, exist_ok=True)
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(_SO_PATH):  # re-check under the lock
+                    subprocess.run(["make", "-C", native_src, "-j"], check=True)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
     return _SO_PATH
 
 

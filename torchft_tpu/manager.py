@@ -1,0 +1,648 @@
+"""Manager: the per-worker fault-tolerance state machine.
+
+Role-equivalent of the reference Manager (torchft/manager.py:148-1046). Owns
+the quorum lifecycle (async on a one-thread executor), process-group
+reconfiguration per quorum, live healing (send/recv checkpoint between
+replica groups), error capture with swallow-to-default semantics, the
+two-phase commit protocol, and step/batches accounting.
+
+JAX-flavored deviations from the reference, by design:
+
+- **State is a pytree.** Registered state-dict functions return/accept JAX
+  pytrees; "zero the tensor on error" becomes *returning a zeros pytree*
+  (arrays are immutable, so corrupt in-flight buffers can simply be dropped).
+- **No stream plumbing.** JAX has no user streams; the recovery "stream" is
+  the quorum executor thread, and ``should_commit`` joins it instead of
+  synchronizing a CUDA event (reference manager.py:873-885).
+- **Eager future chains.** The reference's lazy ``_ManagedWork`` machinery
+  exists to avoid blocking CUDA streams from Python; with host-side
+  collectives + async dispatch there is nothing to block, so futures chain
+  eagerly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket as _socket
+import threading
+import traceback
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
+
+import numpy as np
+
+from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport, RWLock
+from torchft_tpu.coordination import (
+    KvClient,
+    KvStoreServer,
+    ManagerClient,
+    ManagerServer,
+)
+from torchft_tpu.futures import future_timeout
+from torchft_tpu.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.work import DummyWork, Future, FutureWork, Work
+
+T = TypeVar("T")
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Manager", "WorldSizeMode", "ExceptionWithTraceback"]
+
+# env-var config knobs (reference: manager.py:74-89)
+MANAGER_PORT_ENV = "TORCHFT_MANAGER_PORT"
+LIGHTHOUSE_ENV = "TORCHFT_LIGHTHOUSE"
+TIMEOUT_SEC_ENV = "TORCHFT_TIMEOUT_SEC"
+QUORUM_TIMEOUT_SEC_ENV = "TORCHFT_QUORUM_TIMEOUT_SEC"
+CONNECT_TIMEOUT_SEC_ENV = "TORCHFT_CONNECT_TIMEOUT_SEC"
+QUORUM_RETRIES_ENV = "TORCHFT_QUORUM_RETRIES"
+
+
+def _to_seconds(t: "float | timedelta") -> float:
+    return t.total_seconds() if isinstance(t, timedelta) else float(t)
+
+
+class WorldSizeMode(Enum):
+    """Gradient semantics under a changing world size
+    (reference: manager.py:123-139).
+
+    DYNAMIC: quorum can be any size >= min_replica_size; batch size varies.
+    FIXED_WITH_SPARES: at most min_replica_size replicas contribute; extras
+    are hot spares with zeroed contributions, keeping gradient scale fixed.
+    """
+
+    DYNAMIC = "dynamic"
+    FIXED_WITH_SPARES = "fixed_with_spares"
+
+
+class ExceptionWithTraceback(Exception):
+    def __init__(self, e: Exception) -> None:
+        self.original_exception = e
+        self.tb = traceback.format_exception(type(e), e, e.__traceback__)
+        super().__init__("".join(self.tb))
+
+
+class _ManagerLogger:
+    def __init__(self, manager: "Manager", replica_id: str, group_rank: int):
+        self._logger = logger
+        self._replica_id = replica_id
+        self._group_rank = group_rank
+        self._manager = manager
+
+    def _prefix(self) -> str:
+        return f"[{self._replica_id}/{self._group_rank} - step {self._manager._step}]"
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self._prefix()} {msg}")
+
+    def warning(self, msg: str) -> None:
+        self._logger.warning(f"{self._prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self._prefix()} {msg}")
+
+
+class Manager:
+    """Fault-tolerance manager for one worker of one replica group.
+
+    Typical single-process-per-replica-group usage::
+
+        manager = Manager(
+            pg=ProcessGroupHost(),
+            load_state_dict=load_fn,     # applied on live recovery
+            state_dict=state_fn,         # served to healing peers
+            min_replica_size=2,
+        )
+        for batch in data:
+            manager.start_quorum()
+            grads = grad_fn(params, batch)
+            reduced = manager.allreduce(grads).get_future().wait()
+            if manager.should_commit():
+                params = apply(params, reduced)
+    """
+
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        load_state_dict: Optional[Callable[[Any], None]],
+        state_dict: Optional[Callable[[], Any]],
+        min_replica_size: int,
+        use_async_quorum: bool = True,
+        timeout: "float | timedelta" = 60.0,
+        quorum_timeout: "float | timedelta | None" = None,
+        connect_timeout: "float | timedelta | None" = None,
+        replica_id: Optional[str] = None,
+        lighthouse_addr: Optional[str] = None,
+        store_addr: Optional[str] = None,
+        group_rank: int = 0,
+        group_world_size: int = 1,
+        checkpoint_transport: Optional[CheckpointTransport] = None,
+        init_sync: bool = True,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        max_retries: Optional[int] = None,
+        quorum_retries: Optional[int] = None,
+        heartbeat_interval: "float | timedelta" = 0.1,
+        hostname: str = "",
+    ) -> None:
+        self._pg = pg
+        self._min_replica_size = min_replica_size
+        self._use_async_quorum = use_async_quorum
+        self._timeout = float(os.environ.get(TIMEOUT_SEC_ENV, _to_seconds(timeout)))
+        self._quorum_timeout = float(
+            os.environ.get(
+                QUORUM_TIMEOUT_SEC_ENV,
+                _to_seconds(quorum_timeout) if quorum_timeout is not None else self._timeout,
+            )
+        )
+        self._connect_timeout = float(
+            os.environ.get(
+                CONNECT_TIMEOUT_SEC_ENV,
+                _to_seconds(connect_timeout) if connect_timeout is not None else 10.0,
+            )
+        )
+        self._replica_world_size_mode = world_size_mode
+        self._init_sync = init_sync
+        self._max_retries = max_retries
+        self._group_rank = group_rank
+        self._group_world_size = group_world_size
+        quorum_retries = (
+            int(os.environ.get(QUORUM_RETRIES_ENV, 0))
+            if quorum_retries is None
+            else quorum_retries
+        )
+
+        if checkpoint_transport is None:
+            checkpoint_transport = HTTPTransport(timeout=self._timeout)
+        self._checkpoint_transport: CheckpointTransport = checkpoint_transport
+
+        # user state-dict functions, guarded against concurrent mutation
+        # during checkpoint serving (reference: manager.py:243, 366-391)
+        self._state_dict_lock = RWLock(timeout=self._timeout)
+        self._load_state_dict_fns: Dict[str, Callable[[Any], None]] = {}
+        self._user_state_dicts: Dict[str, Callable[[], Any]] = {}
+        if state_dict is not None and load_state_dict is not None:
+            self.register_state_dict_fn("default", load_state_dict, state_dict)
+
+        self._store: Optional[KvStoreServer] = None
+        self._manager: Optional[ManagerServer] = None
+        hostname = hostname or _socket.gethostname()
+
+        if group_rank == 0:
+            # Group leader: owns the rendezvous store and the manager server.
+            if store_addr is None:
+                bind_port = int(os.environ.get(MANAGER_PORT_ENV, 0))
+                self._store = KvStoreServer("0.0.0.0:0")
+                store_addr = f"{hostname}:{self._store.port}"
+            else:
+                bind_port = int(os.environ.get(MANAGER_PORT_ENV, 0))
+
+            if lighthouse_addr is None:
+                lighthouse_addr = os.environ[LIGHTHOUSE_ENV]
+
+            replica_name = replica_id if replica_id is not None else "replica"
+            full_replica_id = f"{replica_name}:{uuid.uuid4()}"
+            self._manager = ManagerServer(
+                replica_id=full_replica_id,
+                lighthouse_addr=lighthouse_addr,
+                hostname=hostname,
+                bind=f"0.0.0.0:{bind_port}",
+                store_addr=store_addr,
+                world_size=group_world_size,
+                heartbeat_interval=heartbeat_interval,
+                connect_timeout=self._connect_timeout,
+                quorum_retries=quorum_retries,
+            )
+            self._replica_id = full_replica_id
+            manager_addr = self._manager.address()
+            # publish for the other group ranks (reference: manager.py:333-337)
+            KvClient(store_addr, connect_timeout=self._connect_timeout).set(
+                "manager_addr", manager_addr, timeout=self._timeout
+            )
+        else:
+            assert store_addr is not None, "non-leader ranks need store_addr"
+            manager_addr = (
+                KvClient(store_addr, connect_timeout=self._connect_timeout)
+                .get("manager_addr", timeout=self._timeout)
+                .decode()
+            )
+            self._replica_id = replica_id if replica_id is not None else "replica"
+
+        self._store_addr = store_addr
+        self._client = ManagerClient(manager_addr, connect_timeout=self._connect_timeout)
+
+        self._step = 0
+        self._quorum_id = -1
+        self._batches_committed = 0
+        self._commit_failures = 0
+        self._errored: Optional[ExceptionWithTraceback] = None
+        self._healing = False
+        self._pending_state_dict: Optional[Dict[str, Any]] = None
+        self._participating_replica_rank: Optional[int] = None
+        self._participating_replica_world_size: int = 0
+        self._num_replicas: int = 0
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="torchft_quorum"
+        )
+        self._quorum_future: Optional[Any] = None
+
+        self._logger = _ManagerLogger(self, self._replica_id, group_rank)
+
+    # ------------------------------------------------------------- state fns
+    def register_state_dict_fn(
+        self,
+        key: str,
+        load_fn: Callable[[Any], None],
+        value_fn: Callable[[], Any],
+    ) -> None:
+        """Register a named (load, save) pair included in live recovery
+        (reference: manager.py:380-391)."""
+        with self._state_dict_lock.w_lock():
+            self._load_state_dict_fns[key] = load_fn
+            self._user_state_dicts[key] = value_fn
+
+    def allow_state_dict_read(self) -> None:
+        if self._state_dict_lock.w_locked():
+            self._state_dict_lock.w_release()
+
+    def disallow_state_dict_read(self) -> None:
+        if not self._state_dict_lock.w_locked():
+            self._state_dict_lock.w_acquire()
+
+    # --------------------------------------------------------------- quorum
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: "float | timedelta | None" = None,
+    ) -> None:
+        """Compute a new quorum (async by default) and ready the manager for a
+        new step. Call before the forward pass (reference: manager.py:560-615)."""
+        if self._quorum_future is not None:
+            self._quorum_future.result()
+
+        self._errored = None
+        self._healing = False
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=_to_seconds(timeout) if timeout is not None else self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # apply eagerly so the forward pass runs on recovered state
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        assert self._quorum_future is not None, "must call start_quorum first"
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
+    ) -> None:
+        try:
+            quorum = self._client._quorum(
+                group_rank=self._group_rank,
+                step=self._step,
+                checkpoint_metadata=self._checkpoint_transport.metadata(),
+                shrink_only=shrink_only,
+                timeout=quorum_timeout,
+                init_sync=self._init_sync,
+                commit_failures=self._commit_failures,
+            )
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"quorum RPC failed: {e}")
+            self.report_error(e)
+            return
+
+        self._num_replicas = quorum.replica_world_size
+
+        # Participation (reference: manager.py:671-690): async quorum means
+        # healing replicas sit this step out, so the participating world is
+        # the max-step cohort; sync quorum heals first, so everyone counts.
+        if self._use_async_quorum or not allow_heal:
+            self._participating_replica_rank = quorum.max_replica_rank
+            self._participating_replica_world_size = quorum.max_world_size
+        else:
+            self._participating_replica_rank = quorum.replica_rank
+            self._participating_replica_world_size = quorum.replica_world_size
+
+        if self._replica_world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            # Spares beyond min_replica_size contribute zeros so gradient
+            # scale stays constant.
+            self._participating_replica_world_size = min(
+                self._participating_replica_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_replica_rank is not None
+                and self._participating_replica_rank >= self._min_replica_size
+            ):
+                self._participating_replica_rank = None
+
+        if quorum.quorum_id != self._quorum_id:
+            store_prefixed_addr = (
+                f"{quorum.store_address}/torchft/{quorum.quorum_id}/{self._group_rank}"
+            )
+            self._logger.info(
+                f"reconfiguring for quorum_id={quorum.quorum_id} store={store_prefixed_addr}"
+            )
+            try:
+                self._quorum_id = quorum.quorum_id
+                self._pg.configure(
+                    store_prefixed_addr,
+                    quorum.replica_rank,
+                    quorum.replica_world_size,
+                    quorum_id=quorum.quorum_id,
+                )
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in pg configure: {e}")
+                self.report_error(e)
+                return
+
+        if allow_heal:
+            try:
+                if quorum.recover_dst_replica_ranks:
+                    self._logger.info(
+                        f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
+                    )
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=quorum.recover_dst_replica_ranks,
+                        step=quorum.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
+
+                if quorum.heal:
+                    self._healing = True
+                    self._logger.info(
+                        f"healing required, fetching metadata from {quorum.recover_src_manager_address}"
+                    )
+                    primary_client = ManagerClient(
+                        quorum.recover_src_manager_address,
+                        connect_timeout=self._connect_timeout,
+                    )
+                    checkpoint_metadata = primary_client._checkpoint_metadata(
+                        self._group_rank, timeout=self._timeout
+                    )
+                    assert quorum.recover_src_replica_rank is not None
+                    self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
+                        src_rank=quorum.recover_src_replica_rank,
+                        metadata=checkpoint_metadata,
+                        step=quorum.max_step,
+                        timeout=self._timeout,
+                    )
+                    # restore ft step/batches immediately; user state is
+                    # applied from the main thread when safe
+                    self.load_state_dict(self._pending_state_dict["torchft"])
+                    self._step = quorum.max_step
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in recovery: {e}")
+                self.report_error(e)
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "must be in healing state"
+        self.wait_quorum()
+        pending = self._pending_state_dict
+        assert pending is not None, "checkpoint was not staged"
+        self._logger.info("applying pending state dict")
+        with self._state_dict_lock.w_lock():
+            user = pending["user"]
+            for key, load_fn in self._load_state_dict_fns.items():
+                if key in user:
+                    load_fn(user[key])
+            self._pending_state_dict = None
+
+    # ------------------------------------------------------------ allreduce
+    def allreduce(
+        self,
+        values: Any,
+        should_quantize: bool = False,
+        reduce_op: ReduceOp = ReduceOp.AVG,
+    ) -> Work:
+        """Fault-tolerant allreduce over a pytree of arrays.
+
+        Returns a Work whose future resolves to the reduced pytree (with
+        device placement matching the inputs). On error, the future resolves
+        to a zeros pytree and the error is tracked for ``should_commit``
+        (reference: manager.py:410-493).
+        """
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(values)
+
+        def rebuild(host_leaves: List[np.ndarray]) -> Any:
+            out = []
+            for orig, host in zip(leaves, host_leaves):
+                if isinstance(orig, jax.Array):
+                    out.append(jax.device_put(host, orig.sharding))
+                else:
+                    out.append(np.asarray(host))
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        def zeros() -> Any:
+            return rebuild([np.zeros(np.shape(l), _np_dtype(l)) for l in leaves])
+
+        if self.errored():
+            return DummyWork(zeros())
+
+        self.wait_quorum()
+        num_participants = self.num_participants()
+
+        host_leaves = [np.asarray(l) for l in leaves]
+        if not self.is_participating():
+            # Spares / healing replicas contribute zeros (reference zeroes the
+            # buffer in place; arrays are immutable here so we swap values).
+            host_leaves = [np.zeros_like(h) for h in host_leaves]
+
+        pg_reduce_op = reduce_op
+        if reduce_op == ReduceOp.AVG:
+            if not all(np.issubdtype(_np_dtype(h), np.floating) or
+                       "bfloat16" in str(_np_dtype(h)) for h in host_leaves):
+                raise ValueError("AVG allreduce requires floating point arrays")
+            pg_reduce_op = ReduceOp.SUM
+
+        try:
+            if should_quantize:
+                from torchft_tpu.collectives import allreduce_quantized
+
+                work = allreduce_quantized(host_leaves, pg_reduce_op, self._pg)
+            else:
+                work = self._pg.allreduce(host_leaves, pg_reduce_op)
+
+            fut = work.get_future()
+
+            def normalize(f: Future) -> Any:
+                reduced = f.value()
+                if reduce_op == ReduceOp.AVG and num_participants > 0:
+                    reduced = [
+                        (r / num_participants).astype(_np_dtype(r)) for r in reduced
+                    ]
+                return rebuild(reduced)
+
+            fut = fut.then(normalize)
+            fut = self.wrap_future(fut, zeros())
+            return FutureWork(fut)
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"got exception in allreduce -- skipping remaining: {e}")
+            self.report_error(e)
+            return DummyWork(zeros())
+
+    # ------------------------------------------------------------- errors
+    def report_error(self, e: Exception) -> None:
+        """Mark the step as corrupt; it will be discarded at should_commit
+        and the PG reconfigured on the next quorum."""
+        self._errored = ExceptionWithTraceback(e)
+
+    def errored(self) -> Optional[ExceptionWithTraceback]:
+        return self._errored
+
+    def wrap_future(
+        self,
+        fut: Future[T],
+        default: T,
+        timeout: "float | timedelta | None" = None,
+    ) -> Future[T]:
+        """Timeout + swallow errors into ``default``, reporting them
+        (reference: manager.py:516-558)."""
+        timed = future_timeout(fut, _to_seconds(timeout) if timeout else self._timeout)
+
+        def callback(f: Future[T]) -> T:
+            try:
+                return f.value()
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in future -- skipping remaining: {e}")
+                self.report_error(e)
+                return default
+
+        return timed.then(callback)
+
+    # ------------------------------------------------------------- commit
+    def should_commit(self, timeout: "float | timedelta | None" = None) -> bool:
+        """Two-phase commit vote across the replica group; True iff every
+        rank of this group is healthy and enough replicas participate
+        (reference: manager.py:848-936)."""
+        # recovery (on the quorum thread) must finish before we decide
+        if self._quorum_future is not None:
+            try:
+                self._quorum_future.result()
+            except Exception as e:  # noqa: BLE001
+                self.report_error(e)
+
+        if (err := self._pg.errored()) is not None:
+            self.report_error(err)
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        should_commit = self._client.should_commit(
+            self._group_rank,
+            self._step,
+            local_should_commit,
+            timeout=_to_seconds(timeout) if timeout else self._timeout,
+        )
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas={enough_replicas} errored={self._errored is not None}"
+        )
+
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+            self._commit_failures = 0
+        else:
+            self._commit_failures += 1
+            if (
+                self._max_retries is not None
+                and self._commit_failures > self._max_retries
+            ):
+                msg = (
+                    f"should_commit failed {self._commit_failures} times "
+                    f"consecutively, exceeding max_retries={self._max_retries}"
+                )
+                self._logger.exception(msg)
+                raise RuntimeError(msg)
+
+        return should_commit
+
+    # -------------------------------------------------------- introspection
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def _manager_state_dict(self) -> Dict[str, Any]:
+        with self._state_dict_lock.r_lock():
+            assert len(self._user_state_dicts) > 0, "user state_dict is not initialized"
+            return {
+                "user": {key: fn() for key, fn in self._user_state_dicts.items()},
+                "torchft": self.state_dict(),
+            }
+
+    def state_dict(self) -> Dict[str, int]:
+        """Manager state for durable checkpoints: include this in your own
+        periodic checkpoints (reference: manager.py:938-958)."""
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def current_step(self) -> int:
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    def participating_rank(self) -> Optional[int]:
+        if self._quorum_future is None:
+            return None
+        self.wait_quorum()
+        return self._participating_replica_rank
+
+    # aliases used by wrappers
+    def replica_rank(self) -> Optional[int]:
+        return self.participating_rank()
+
+    def num_participants(self) -> int:
+        if self._quorum_future is None:
+            return 0
+        self.wait_quorum()
+        assert self._participating_replica_world_size >= 0
+        return self._participating_replica_world_size
+
+    def num_replicas(self) -> int:
+        """Total replicas in the current quorum, including non-participants."""
+        return self._num_replicas
+
+    def is_participating(self) -> bool:
+        if self._participating_replica_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+    # ------------------------------------------------------------ lifecycle
+    def shutdown(self, wait: bool = True) -> None:
+        self._checkpoint_transport.shutdown(wait=wait)
+        if self._manager is not None:
+            self._manager.shutdown()
+        if self._store is not None:
+            self._store.shutdown()
+        self._executor.shutdown(wait=wait)
+        self._pg.shutdown()
+
+    @property
+    def store_addr(self) -> str:
+        """Rendezvous store address of this replica group (leader's store)."""
+        assert self._store_addr is not None
+        return self._store_addr
+
+
+def _np_dtype(x: Any) -> Any:
+    return np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype

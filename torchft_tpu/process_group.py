@@ -1,0 +1,821 @@
+"""Reconfigurable process groups: the fault-tolerant communication backend.
+
+Role-equivalent of the reference's torchft/process_group.py (the shim over
+NCCL/Gloo/XCCL that can be torn down and rebuilt per quorum without
+restarting the process). The TPU-native design differs deliberately:
+
+- **Immutable arrays.** JAX arrays cannot be mutated in place, so collectives
+  return their results through the Work's future instead of writing into the
+  input buffers. ``allreduce([x])`` yields a Work whose future resolves to the
+  reduced arrays.
+- **Two planes, like the reference.** ``ProcessGroupHost`` is the Gloo
+  equivalent: CPU collectives over a full TCP mesh between replica groups,
+  used for control data, tests, and as the DCN bridge for cross-replica-group
+  traffic. Device arrays are staged host-side (device_get/device_put). The
+  intra-replica-group plane (FSDP/TP shard dims) is *not* a process group at
+  all on TPU — it is XLA SPMD over a jax.sharding.Mesh (see
+  torchft_tpu/parallel/), exactly as the reference delegates intra-group
+  parallelism to torchtitan (reference README.md:40).
+- **Abort-based timeouts.** Collectives are issued on a dedicated dispatch
+  thread per PG; timeouts arm a watchdog that calls ``abort()`` (closing the
+  sockets), mirroring the reference's NCCL abort recovery
+  (process_group.py:780-891).
+
+Reconfiguration handshake matches the reference: ``configure(store_addr,
+replica_rank, replica_world_size, ...)`` tears down the old communicator and
+rendezvouses a new one via the KV store under a per-quorum prefix
+(reference: manager.py:692-737).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import pickle
+import queue
+import socket
+import struct
+import threading
+from abc import ABC, abstractmethod
+from contextlib import contextmanager
+from datetime import timedelta
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchft_tpu.coordination import KvClient
+from torchft_tpu.futures import context_timeout
+from torchft_tpu.work import DummyWork, Future, FutureWork, Work
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ReduceOp",
+    "ProcessGroup",
+    "ProcessGroupDummy",
+    "ProcessGroupHost",
+    "ErrorSwallowingProcessGroupWrapper",
+    "FakeProcessGroupWrapper",
+    "ManagedProcessGroup",
+]
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "product"
+
+
+def _reduce_np(op: ReduceOp, bufs: List[np.ndarray]) -> np.ndarray:
+    out = bufs[0].copy()
+    for b in bufs[1:]:
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out += b
+        elif op == ReduceOp.MAX:
+            np.maximum(out, b, out=out)
+        elif op == ReduceOp.MIN:
+            np.minimum(out, b, out=out)
+        elif op == ReduceOp.PRODUCT:
+            out *= b
+    if op == ReduceOp.AVG:
+        out = out / len(bufs)
+    return out
+
+
+def _to_host(x: Any) -> np.ndarray:
+    """Stage a jax.Array (or anything array-like) to host memory."""
+    return np.asarray(x)
+
+
+class ProcessGroup(ABC):
+    """Abstract reconfigurable process group.
+
+    API mirror of the reference ProcessGroup ABC (process_group.py:131-399)
+    with JAX-style value-returning collectives.
+    """
+
+    def __init__(self) -> None:
+        self._timeout: float = 60.0
+
+    # -- lifecycle --------------------------------------------------------
+    @abstractmethod
+    def configure(
+        self,
+        store_addr: str,
+        replica_rank: int,
+        replica_world_size: int,
+        quorum_id: int = 0,
+    ) -> None:
+        """(Re)initialize the communicator for a new quorum.
+
+        ``store_addr`` is ``"host:port/prefix"`` into the rendezvous KV store;
+        the prefix embeds the quorum id so concurrent reconfigurations never
+        collide (reference: manager.py:703-705).
+        """
+
+    @abstractmethod
+    def abort(self) -> None:
+        """Hard-kill in-flight collectives; the PG stays errored until
+        reconfigured."""
+
+    @abstractmethod
+    def shutdown(self) -> None:
+        """Tear down cleanly (terminal)."""
+
+    @abstractmethod
+    def errored(self) -> Optional[Exception]:
+        """Error state since last configure, if any."""
+
+    @abstractmethod
+    def size(self) -> int: ...
+
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    def set_timeout(self, timeout: "float | timedelta") -> None:
+        self._timeout = (
+            timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
+        )
+
+    def getBackendName(self) -> str:
+        return type(self).__name__
+
+    # -- collectives ------------------------------------------------------
+    @abstractmethod
+    def allreduce(self, arrays: Sequence[Any], op: ReduceOp = ReduceOp.SUM) -> Work:
+        """Future resolves to the reduced arrays (same structure as input)."""
+
+    @abstractmethod
+    def allgather(self, arrays: Sequence[Any]) -> Work:
+        """Future resolves to a list (one per rank) of lists of arrays."""
+
+    @abstractmethod
+    def broadcast(self, arrays: Sequence[Any], root: int = 0) -> Work:
+        """Future resolves to root's arrays on every rank."""
+
+    @abstractmethod
+    def reduce_scatter(
+        self, input_chunks: Sequence[Sequence[Any]], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        """``input_chunks[r]`` is this rank's contribution destined for rank r;
+        future resolves to the reduced chunk owned by this rank."""
+
+    @abstractmethod
+    def alltoall(self, input_chunks: Sequence[Any]) -> Work:
+        """Future resolves to [chunk from rank 0, chunk from rank 1, ...]."""
+
+    @abstractmethod
+    def send(self, arrays: Sequence[Any], dst: int, tag: int = 0) -> Work: ...
+
+    @abstractmethod
+    def recv(self, src: int, tag: int = 0) -> Work:
+        """Future resolves to the received arrays."""
+
+    def barrier(self) -> Work:
+        return self.allreduce([np.zeros((1,), dtype=np.float32)])
+
+
+class ProcessGroupDummy(ProcessGroup):
+    """World-size-1 no-op PG: collectives return their inputs.
+
+    Reference: process_group.py:1005-1134 (used to soak up init broadcasts
+    and in tests).
+    """
+
+    def __init__(self, rank: int = 0, world: int = 1) -> None:
+        super().__init__()
+        self._rank = rank
+        self._world = world
+        self.configure_count = 0
+
+    def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        self.configure_count += 1
+
+    def abort(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def errored(self) -> Optional[Exception]:
+        return None
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        return DummyWork(list(arrays))
+
+    def allgather(self, arrays):
+        return DummyWork([list(arrays)])
+
+    def broadcast(self, arrays, root=0):
+        return DummyWork(list(arrays))
+
+    def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
+        return DummyWork(list(input_chunks[0]))
+
+    def alltoall(self, input_chunks):
+        return DummyWork(list(input_chunks))
+
+    def send(self, arrays, dst, tag=0):
+        return DummyWork(None)
+
+    def recv(self, src, tag=0):
+        return DummyWork(None)
+
+
+# ---------------------------------------------------------------------------
+# Host TCP mesh process group
+# ---------------------------------------------------------------------------
+
+_HDR = struct.Struct("!Q")
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = _recv_exact(sock, _HDR.size)
+    (length,) = _HDR.unpack(hdr)
+    return _recv_exact(sock, length)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+class _Comm:
+    """One generation of the TCP full mesh. Abort closes every socket, which
+    makes all in-flight ops fail fast; a new generation is built on the next
+    configure()."""
+
+    def __init__(
+        self,
+        rank: int,
+        world: int,
+        store_addr: str,
+        quorum_id: int,
+        timeout: float,
+    ) -> None:
+        self.rank = rank
+        self.world = world
+        self.aborted = False
+        self._lock = threading.Lock()
+        self.peers: Dict[int, socket.socket] = {}
+
+        # store_addr is "host:port/prefix"; the prefix (set per-quorum and
+        # per-group-rank by the Manager, reference manager.py:703-705) plus the
+        # quorum id namespaces this generation's rendezvous keys.
+        host_port, _, path = store_addr.partition("/")
+        prefix = f"{path or 'pg'}/{quorum_id}"
+        kv = KvClient(host_port, connect_timeout=timeout)
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(world)
+        port = listener.getsockname()[1]
+        self._listener = listener
+
+        my_host = socket.gethostname()
+        kv.set(f"{prefix}/addr_{rank}", f"{my_host}:{port}", timeout=timeout)
+
+        # Deterministic connection pattern: rank i dials every j < i and
+        # accepts from every j > i (with a hello byte carrying the dialer's
+        # rank so accepts can arrive in any order).
+        for j in range(rank):
+            addr = kv.get(f"{prefix}/addr_{j}", timeout=timeout).decode()
+            host, _, p = addr.rpartition(":")
+            s = socket.create_connection((host, int(p)), timeout=timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_msg(s, pickle.dumps(("hello", rank)))
+            self.peers[j] = s
+        listener.settimeout(timeout)
+        for _ in range(world - 1 - rank):
+            s, _ = listener.accept()
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            tag, peer_rank = pickle.loads(_recv_msg(s))
+            assert tag == "hello"
+            self.peers[peer_rank] = s
+
+    def settimeout(self, timeout: float) -> None:
+        with self._lock:
+            for s in self.peers.values():
+                try:
+                    s.settimeout(timeout)
+                except OSError:
+                    pass
+
+    def send_to(self, peer: int, obj: Any) -> None:
+        _send_msg(self.peers[peer], pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def recv_from(self, peer: int) -> Any:
+        return pickle.loads(_recv_msg(self.peers[peer]))
+
+    def exchange(self, payloads: Dict[int, Any]) -> Dict[int, Any]:
+        """Send payloads[r] to each rank r and receive one object from every
+        peer. Deadlock-free: a writer thread streams our sends while the
+        caller thread drains receives."""
+        err: List[BaseException] = []
+
+        def _writer() -> None:
+            try:
+                for peer in sorted(payloads):
+                    if peer != self.rank:
+                        self.send_to(peer, payloads[peer])
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=_writer, daemon=True)
+        t.start()
+        out: Dict[int, Any] = {}
+        if self.rank in payloads:
+            out[self.rank] = payloads[self.rank]
+        for peer in range(self.world):
+            if peer == self.rank:
+                continue
+            out[peer] = self.recv_from(peer)
+        t.join()
+        if err:
+            raise err[0]
+        return out
+
+    def abort(self) -> None:
+        with self._lock:
+            self.aborted = True
+            for s in self.peers.values():
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class ProcessGroupHost(ProcessGroup):
+    """CPU collectives over a TCP full mesh between replica groups.
+
+    The Gloo-equivalent data plane (reference ProcessGroupGloo,
+    process_group.py:643-711): used for the fault-tolerant replicated-dim
+    traffic, tests, and control data. JAX arrays are staged through host
+    memory; outputs are plain numpy (callers ``device_put`` as needed).
+
+    Collectives are dispatched on a single background thread (preserving
+    issue order, like a communication stream); each op arms an abort watchdog
+    for ``timeout`` seconds (reference abort-based recovery,
+    process_group.py:739-763).
+    """
+
+    def __init__(self, timeout: "float | timedelta" = 60.0) -> None:
+        super().__init__()
+        self.set_timeout(timeout)
+        self._comm: Optional[_Comm] = None
+        self._error: Optional[Exception] = None
+        self._rank = 0
+        self._world = 1
+        self._dispatch: Optional[queue.Queue] = None
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle --------------------------------------------------------
+    def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        with self._lock:
+            self._teardown_locked()
+            self._comm = _Comm(
+                rank=replica_rank,
+                world=replica_world_size,
+                store_addr=store_addr,
+                quorum_id=quorum_id,
+                timeout=self._timeout,
+            )
+            self._rank = replica_rank
+            self._world = replica_world_size
+            self._error = None
+            self._dispatch = queue.Queue()
+            self._dispatch_thread = threading.Thread(
+                target=self._dispatch_loop,
+                args=(self._dispatch,),
+                daemon=True,
+                name=f"pg_host_dispatch_r{replica_rank}",
+            )
+            self._dispatch_thread.start()
+
+    def _teardown_locked(self) -> None:
+        if self._comm is not None:
+            self._comm.abort()
+            self._comm = None
+        if self._dispatch is not None:
+            self._dispatch.put(None)  # poison pill
+            self._dispatch = None
+
+    def abort(self) -> None:
+        with self._lock:
+            if self._comm is not None:
+                self._comm.abort()
+            if self._error is None:
+                self._error = RuntimeError("process group aborted")
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._teardown_locked()
+
+    def errored(self) -> Optional[Exception]:
+        return self._error
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch_loop(self, q: queue.Queue) -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                with context_timeout(self.abort, self._timeout):
+                    fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                self._error = e if isinstance(e, Exception) else RuntimeError(str(e))
+                try:
+                    fut.set_exception(e)
+                except RuntimeError:
+                    pass
+
+    def _submit(self, fn: Callable[[], Any]) -> Work:
+        with self._lock:
+            if self._comm is None or self._dispatch is None:
+                raise RuntimeError("process group is not configured")
+            if self._error is not None:
+                raise self._error
+            fut: Future[Any] = Future()
+            self._dispatch.put((fn, fut))
+            return FutureWork(fut)
+
+    # -- collectives ------------------------------------------------------
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        host = [_to_host(a) for a in arrays]
+
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            if comm.world == 1:
+                return host if op != ReduceOp.AVG else [h.copy() for h in host]
+            payload = {r: host for r in range(comm.world) if r != comm.rank}
+            gathered = comm.exchange({**payload, comm.rank: host})
+            return [
+                _reduce_np(op, [gathered[r][i] for r in range(comm.world)])
+                for i in range(len(host))
+            ]
+
+        return self._submit(_run)
+
+    def allgather(self, arrays):
+        host = [_to_host(a) for a in arrays]
+
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            if comm.world == 1:
+                return [host]
+            gathered = comm.exchange(
+                {r: host for r in range(comm.world)}
+            )
+            return [gathered[r] for r in range(comm.world)]
+
+        return self._submit(_run)
+
+    def broadcast(self, arrays, root=0):
+        host = [_to_host(a) for a in arrays]
+
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            if comm.world == 1:
+                return host
+            if comm.rank == root:
+                for peer in range(comm.world):
+                    if peer != comm.rank:
+                        comm.send_to(peer, host)
+                return host
+            return comm.recv_from(root)
+
+        return self._submit(_run)
+
+    def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
+        host = [[_to_host(a) for a in chunk] for chunk in input_chunks]
+
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            if comm.world == 1:
+                return host[0]
+            assert len(host) == comm.world, "need one chunk per rank"
+            gathered = comm.exchange({r: host[r] for r in range(comm.world)})
+            mine = [gathered[r] for r in range(comm.world)]
+            return [
+                _reduce_np(op, [mine[r][i] for r in range(comm.world)])
+                for i in range(len(host[0]))
+            ]
+
+        return self._submit(_run)
+
+    def alltoall(self, input_chunks):
+        host = [_to_host(a) for a in input_chunks]
+
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            if comm.world == 1:
+                return host
+            assert len(host) == comm.world, "need one chunk per rank"
+            gathered = comm.exchange({r: host[r] for r in range(comm.world)})
+            return [gathered[r] for r in range(comm.world)]
+
+        return self._submit(_run)
+
+    def send(self, arrays, dst, tag=0):
+        host = [_to_host(a) for a in arrays]
+
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            comm.send_to(dst, ("p2p", tag, host))
+            return None
+
+        return self._submit(_run)
+
+    def recv(self, src, tag=0):
+        def _run():
+            comm = self._comm
+            assert comm is not None
+            kind, got_tag, host = comm.recv_from(src)
+            assert kind == "p2p" and got_tag == tag, (kind, got_tag, tag)
+            return host
+
+        return self._submit(_run)
+
+
+# ---------------------------------------------------------------------------
+# Wrappers
+# ---------------------------------------------------------------------------
+
+
+class _ErrorSwallowingWork(Work):
+    """Work whose future errors resolve to a default value instead of raising
+    (reference: process_group.py:1137-1173)."""
+
+    def __init__(self, pg: "ErrorSwallowingProcessGroupWrapper", work: Work, default: Any):
+        self._pg = pg
+        self._work = work
+        self._future: Future[Any] = Future()
+
+        def _transfer(f: Future[Any]) -> None:
+            exc = f.exception()
+            if exc is not None:
+                self._pg.report_error(
+                    exc if isinstance(exc, Exception) else RuntimeError(str(exc))
+                )
+                self._future.set_result(default)
+            else:
+                self._future.set_result(f.value())
+
+        work.get_future().add_done_callback(_transfer)
+
+    def wait(self, timeout=None):
+        self._future.wait(timeout)
+        return True
+
+    def get_future(self):
+        return self._future
+
+
+class ErrorSwallowingProcessGroupWrapper(ProcessGroup):
+    """Swallows collective errors: after the first error every op returns its
+    input unchanged (identity for the train loop) until reconfigured.
+
+    Reference: process_group.py:1176-1249. This is what lets a replica keep
+    stepping through a dead communicator — the Manager discards the step at
+    should_commit time.
+    """
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__()
+        self._pg = pg
+        self._error: Optional[Exception] = None
+
+    def parent(self) -> ProcessGroup:
+        return self._pg
+
+    def error(self) -> Optional[Exception]:
+        return self._error
+
+    def report_error(self, e: Exception) -> None:
+        self._error = e
+
+    def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        self._error = None
+        self._pg.configure(store_addr, replica_rank, replica_world_size, quorum_id)
+
+    def abort(self) -> None:
+        self._pg.abort()
+
+    def shutdown(self) -> None:
+        self._pg.shutdown()
+
+    def errored(self) -> Optional[Exception]:
+        return self._error or self._pg.errored()
+
+    def size(self) -> int:
+        return self._pg.size()
+
+    def rank(self) -> int:
+        return self._pg.rank()
+
+    def set_timeout(self, timeout) -> None:
+        self._pg.set_timeout(timeout)
+
+    def _guard(self, fn: Callable[[], Work], default: Any) -> Work:
+        if self._error is not None:
+            return DummyWork(default)
+        try:
+            return _ErrorSwallowingWork(self, fn(), default)
+        except Exception as e:  # noqa: BLE001
+            self.report_error(e)
+            return DummyWork(default)
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        default = [_to_host(a) for a in arrays]
+        return self._guard(lambda: self._pg.allreduce(arrays, op), default)
+
+    def allgather(self, arrays):
+        default = [[_to_host(a) for a in arrays]]
+        return self._guard(lambda: self._pg.allgather(arrays), default)
+
+    def broadcast(self, arrays, root=0):
+        default = [_to_host(a) for a in arrays]
+        return self._guard(lambda: self._pg.broadcast(arrays, root), default)
+
+    def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
+        default = [_to_host(a) for a in input_chunks[0]]
+        return self._guard(lambda: self._pg.reduce_scatter(input_chunks, op), default)
+
+    def alltoall(self, input_chunks):
+        default = [_to_host(a) for a in input_chunks]
+        return self._guard(lambda: self._pg.alltoall(input_chunks), default)
+
+    def send(self, arrays, dst, tag=0):
+        return self._guard(lambda: self._pg.send(arrays, dst, tag), None)
+
+    def recv(self, src, tag=0):
+        return self._guard(lambda: self._pg.recv(src, tag), None)
+
+
+class FakeProcessGroupWrapper(ProcessGroup):
+    """Test-only fault injection: ``report_future_error`` makes the next
+    op's future raise (reference: process_group.py:1252-1317)."""
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__()
+        self._pg = pg
+        self._next_error: Optional[Exception] = None
+        self._next_configure_error: Optional[Exception] = None
+
+    def report_future_error(self, e: Exception) -> None:
+        self._next_error = e
+
+    def report_configure_error(self, e: Exception) -> None:
+        self._next_configure_error = e
+
+    def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        if self._next_configure_error is not None:
+            e, self._next_configure_error = self._next_configure_error, None
+            raise e
+        self._pg.configure(store_addr, replica_rank, replica_world_size, quorum_id)
+
+    def abort(self) -> None:
+        self._pg.abort()
+
+    def shutdown(self) -> None:
+        self._pg.shutdown()
+
+    def errored(self) -> Optional[Exception]:
+        return self._pg.errored()
+
+    def size(self) -> int:
+        return self._pg.size()
+
+    def rank(self) -> int:
+        return self._pg.rank()
+
+    def set_timeout(self, timeout) -> None:
+        self._pg.set_timeout(timeout)
+
+    def _maybe_fail(self, work: Work) -> Work:
+        if self._next_error is not None:
+            e, self._next_error = self._next_error, None
+            fut: Future[Any] = Future()
+
+            def _fail(_f: Future[Any]) -> None:
+                try:
+                    fut.set_exception(e)
+                except RuntimeError:
+                    pass
+
+            work.get_future().add_done_callback(_fail)
+            return FutureWork(fut)
+        return work
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        return self._maybe_fail(self._pg.allreduce(arrays, op))
+
+    def allgather(self, arrays):
+        return self._maybe_fail(self._pg.allgather(arrays))
+
+    def broadcast(self, arrays, root=0):
+        return self._maybe_fail(self._pg.broadcast(arrays, root))
+
+    def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
+        return self._maybe_fail(self._pg.reduce_scatter(input_chunks, op))
+
+    def alltoall(self, input_chunks):
+        return self._maybe_fail(self._pg.alltoall(input_chunks))
+
+    def send(self, arrays, dst, tag=0):
+        return self._maybe_fail(self._pg.send(arrays, dst, tag))
+
+    def recv(self, src, tag=0):
+        return self._maybe_fail(self._pg.recv(src, tag))
+
+
+class ManagedProcessGroup(ProcessGroup):
+    """PG adapter whose allreduce routes through a Manager, so unmodified
+    data-parallel code picks up quorum participation + error swallowing
+    (reference: process_group.py:1320-1353)."""
+
+    def __init__(self, manager: "Any") -> None:  # Manager (avoid cycle)
+        super().__init__()
+        self._manager = manager
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        return self._manager.allreduce(list(arrays))
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def rank(self) -> int:
+        return self._manager.replica_rank()
+
+    def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        raise RuntimeError("ManagedProcessGroup is configured by its Manager")
+
+    def abort(self) -> None:
+        self._manager._pg.abort()
+
+    def shutdown(self) -> None:
+        self._manager._pg.shutdown()
+
+    def errored(self) -> Optional[Exception]:
+        return self._manager._pg.errored()
+
+    def allgather(self, arrays):
+        raise NotImplementedError("managed PG only routes allreduce")
+
+    def broadcast(self, arrays, root=0):
+        raise NotImplementedError("managed PG only routes allreduce")
+
+    def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
+        raise NotImplementedError("managed PG only routes allreduce")
+
+    def alltoall(self, input_chunks):
+        raise NotImplementedError("managed PG only routes allreduce")
+
+    def send(self, arrays, dst, tag=0):
+        raise NotImplementedError("managed PG only routes allreduce")
+
+    def recv(self, src, tag=0):
+        raise NotImplementedError("managed PG only routes allreduce")

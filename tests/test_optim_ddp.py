@@ -1,0 +1,68 @@
+"""OptimizerWrapper + DDP helper tests (reference: optim_test.py, ddp_test.py)."""
+
+from unittest.mock import MagicMock
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.ddp import DistributedDataParallel, PureDistributedDataParallel
+from torchft_tpu.optim import OptimizerWrapper
+from torchft_tpu.work import DummyWork
+
+
+def mock_manager(commit=True):
+    m = MagicMock()
+    m.allreduce.side_effect = lambda v, should_quantize=False: DummyWork(v)
+    m.should_commit.return_value = commit
+    return m
+
+
+class TestOptimizerWrapper:
+    def test_step_applies_update_on_commit(self):
+        m = mock_manager(commit=True)
+        opt = OptimizerWrapper(m, optax.sgd(0.5))
+        params = {"w": np.array([1.0], dtype=np.float32)}
+        state = opt.init(params)
+        opt.start_step()
+        m.start_quorum.assert_called_once()
+        grads = {"w": np.array([0.2], dtype=np.float32)}
+        new_params, new_state, committed = opt.step(params, state, grads)
+        assert committed
+        np.testing.assert_allclose(new_params["w"], [0.9])
+
+    def test_step_discarded_on_failed_commit(self):
+        m = mock_manager(commit=False)
+        opt = OptimizerWrapper(m, optax.sgd(0.5))
+        params = {"w": np.array([1.0], dtype=np.float32)}
+        state = opt.init(params)
+        new_params, new_state, committed = opt.step(
+            params, state, {"w": np.array([0.2], dtype=np.float32)}
+        )
+        assert not committed
+        assert new_params is params
+        assert new_state is state
+
+    def test_zero_grad_alias(self):
+        m = mock_manager()
+        opt = OptimizerWrapper(m, optax.sgd(0.1))
+        opt.zero_grad()
+        m.start_quorum.assert_called_once()
+
+
+class TestDDP:
+    def test_average_gradients_single_collective(self):
+        m = mock_manager()
+        ddp = DistributedDataParallel(m)
+        grads = {"a": np.ones(2), "b": np.zeros(3)}
+        out = ddp.average_gradients(grads)
+        assert m.allreduce.call_count == 1
+        np.testing.assert_allclose(out["a"], 1.0)
+
+    def test_pure_ddp_per_leaf(self):
+        m = mock_manager()
+        ddp = PureDistributedDataParallel(m)
+        grads = {"a": np.ones(2), "b": np.zeros(3)}
+        out = ddp.average_gradients(grads)
+        assert m.allreduce.call_count == 2
+        np.testing.assert_allclose(out["b"], 0.0)

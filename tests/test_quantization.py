@@ -1,0 +1,182 @@
+"""fp8 quantization + quantized collective tests (reference:
+quantization_test.py, collectives_test.py)."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.collectives import allreduce_quantized, reduce_scatter_quantized
+from torchft_tpu.coordination import KvStoreServer
+from torchft_tpu.ops.quantization import (
+    dequantize_fp8_rowwise,
+    fused_dequantize_fp8,
+    fused_quantize_fp8,
+    quantize_fp8_rowwise,
+)
+from torchft_tpu.process_group import ProcessGroupHost, ReduceOp
+
+
+class TestRowwiseFp8:
+    def test_roundtrip_error_bounded(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1000).astype(np.float32) * 10
+        q, scales, n = quantize_fp8_rowwise(x)
+        out = dequantize_fp8_rowwise(q, scales, n)
+        assert out.shape == x.shape
+        # e4m3 has ~2 decimal digits; rowwise scaling keeps rel error small
+        np.testing.assert_allclose(out, x, rtol=0.08, atol=1e-3)
+
+    def test_zero_rows(self):
+        x = np.zeros(600, np.float32)
+        q, scales, n = quantize_fp8_rowwise(x)
+        out = dequantize_fp8_rowwise(q, scales, n)
+        np.testing.assert_array_equal(out, 0.0)
+
+    def test_extreme_magnitudes(self):
+        x = np.array([1e-6, 1e6, -1e6, 0.5], np.float32)
+        q, scales, n = quantize_fp8_rowwise(x, row=4)
+        out = dequantize_fp8_rowwise(q, scales, n)
+        np.testing.assert_allclose(out[[1, 2]], x[[1, 2]], rtol=0.07)
+
+    def test_payload_is_1_byte_per_elem(self):
+        x = np.ones(512, np.float32)
+        q, scales, n = quantize_fp8_rowwise(x, row=512)
+        assert q.nbytes == 512
+        assert scales.nbytes == 4
+
+
+class TestPallasFused:
+    def test_matches_host_quantizer(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(777).astype(np.float32))
+        q, scales, n = fused_quantize_fp8(x, row=128)
+        out = fused_dequantize_fp8(q, scales, n, row=128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=0.08, atol=1e-3)
+
+    def test_2d_input(self):
+        import jax.numpy as jnp
+
+        x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8) / 7.0
+        q, scales, n = fused_quantize_fp8(x, row=32)
+        out = fused_dequantize_fp8(q, scales, n, row=32)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x).reshape(-1), rtol=0.08, atol=1e-3
+        )
+
+
+@pytest.fixture()
+def store():
+    s = KvStoreServer("127.0.0.1:0")
+    yield s
+    s.shutdown()
+
+
+def make_pgs(store, world, quorum_id=31):
+    pgs = [ProcessGroupHost(timeout=10.0) for _ in range(world)]
+    addr = f"127.0.0.1:{store.port}/quant"
+
+    def cfg(rank):
+        pgs[rank].configure(addr, rank, world, quorum_id=quorum_id)
+
+    with ThreadPoolExecutor(max_workers=world) as ex:
+        list(ex.map(cfg, range(world)))
+    return pgs
+
+
+class TestQuantizedCollectives:
+    WORLD = 3
+
+    def test_allreduce_quantized_sum(self, store):
+        pgs = make_pgs(store, self.WORLD)
+        rng = np.random.RandomState(7)
+        inputs = [
+            [rng.randn(600).astype(np.float32), rng.randn(33).astype(np.float32)]
+            for _ in range(self.WORLD)
+        ]
+        expected = [
+            sum(inputs[r][i] for r in range(self.WORLD)) for i in range(2)
+        ]
+
+        def run(rank):
+            return (
+                allreduce_quantized(inputs[rank], ReduceOp.SUM, pgs[rank])
+                .get_future()
+                .wait(timeout=30)
+            )
+
+        with ThreadPoolExecutor(max_workers=self.WORLD) as ex:
+            outs = list(ex.map(run, range(self.WORLD)))
+        for out in outs:
+            for i in range(2):
+                # double fp8 e4m3 quantization (per-input + post-reduce):
+                # abs error is bounded by ~2x the row quantum (amax * 2^-3)
+                amax = float(np.max(np.abs(expected[i])))
+                np.testing.assert_allclose(
+                    out[i], expected[i], rtol=0.15, atol=amax / 4
+                )
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_allreduce_quantized_avg(self, store):
+        pgs = make_pgs(store, 2, quorum_id=32)
+        inputs = [[np.full(100, 2.0, np.float32)], [np.full(100, 4.0, np.float32)]]
+
+        def run(rank):
+            return (
+                allreduce_quantized(inputs[rank], ReduceOp.AVG, pgs[rank])
+                .get_future()
+                .wait(timeout=30)
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            outs = list(ex.map(run, range(2)))
+        for out in outs:
+            np.testing.assert_allclose(out[0], 3.0, rtol=0.07)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_reduce_scatter_quantized(self, store):
+        pgs = make_pgs(store, 2, quorum_id=33)
+        inputs = [[np.arange(8, dtype=np.float32)], [np.arange(8, dtype=np.float32)]]
+
+        def run(rank):
+            return (
+                reduce_scatter_quantized(inputs[rank], ReduceOp.SUM, pgs[rank])
+                .get_future()
+                .wait(timeout=30)
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            outs = list(ex.map(run, range(2)))
+        full = np.arange(8, dtype=np.float32) * 2
+        np.testing.assert_allclose(outs[0], full[:4], rtol=0.07, atol=0.01)
+        np.testing.assert_allclose(outs[1], full[4:], rtol=0.07, atol=0.01)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_unsupported_op_raises(self, store):
+        pgs = make_pgs(store, 1, quorum_id=34)
+        with pytest.raises(ValueError):
+            allreduce_quantized([np.ones(4)], ReduceOp.MAX, pgs[0])
+        pgs[0].shutdown()
+
+    def test_manager_allreduce_quantized_path(self, store):
+        """should_quantize=True end-to-end through the Manager."""
+        from unittest.mock import MagicMock, patch
+
+        from torchft_tpu.manager import Manager
+        from tests.test_manager import make_manager, make_quorum
+
+        pgs = make_pgs(store, 1, quorum_id=35)
+        m = make_manager(pg=pgs[0], quorum=make_quorum(max_world_size=1))
+        m.start_quorum()
+        out = (
+            m.allreduce({"w": np.full(16, 3.0, np.float32)}, should_quantize=True)
+            .get_future()
+            .wait(timeout=30)
+        )
+        np.testing.assert_allclose(out["w"], 3.0, rtol=0.07)
+        pgs[0].shutdown()

@@ -56,6 +56,16 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._spec: Optional[TreeSpecPayload] = None
         self._chunks: Optional[List[bytes]] = None  # pre-assembled chunk bodies
 
+        # Delivery tracking: how many chunk fetches we expect for the staged
+        # step vs. how many were served. disallow_checkpoint() grants a grace
+        # window for lagging receivers before closing the window — without
+        # this, a fast sender can reach should_commit and re-lock before a
+        # healing peer started its fetch, failing the peer's recovery for a
+        # full extra step.
+        self._fetch_cond = threading.Condition()
+        self._expected_fetches = 0
+        self._served_fetches = 0
+
         transport = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -115,6 +125,9 @@ class HTTPTransport(CheckpointTransport[Any]):
         if what.startswith("chunk_"):
             i = int(what[len("chunk_"):])
             if 0 <= i < len(self._chunks):
+                with self._fetch_cond:
+                    self._served_fetches += 1
+                    self._fetch_cond.notify_all()
                 return self._chunks[i]
         return None
 
@@ -140,12 +153,23 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._step = step
         self._spec = spec
         self._chunks = chunks
+        with self._fetch_cond:
+            self._expected_fetches = len(chunks) * max(len(dst_ranks), 0)
+            self._served_fetches = 0
         if not self._have_state:
             self._have_state = True
             self._state_lock.w_release()
 
-    def disallow_checkpoint(self) -> None:
+    def disallow_checkpoint(self, grace: Optional[float] = None) -> None:
         if self._have_state:
+            # Grace window: give expected receivers a chance to fetch before
+            # closing. Bounded so a crashed receiver can't stall the sender.
+            grace = min(self._timeout, 10.0) if grace is None else grace
+            with self._fetch_cond:
+                self._fetch_cond.wait_for(
+                    lambda: self._served_fetches >= self._expected_fetches,
+                    timeout=grace,
+                )
             if not self._state_lock.w_acquire(timeout=self._timeout):
                 raise TimeoutError(
                     "timed out waiting for in-flight checkpoint reads to finish"

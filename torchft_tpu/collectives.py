@@ -1,0 +1,151 @@
+"""Quantized collectives: fp8-compressed allreduce / reduce-scatter.
+
+Algorithm mirror of the reference (torchft/collectives.py:159-415): quantize
+to rowwise-scaled fp8, alltoall so each rank owns one chunk, dequantize +
+reduce locally in f32, requantize, allgather the reduced chunks, dequantize.
+SUM and AVG only. Cuts the replicated-dim wire traffic ~4x vs f32 — on a
+TPU fleet this is DCN bandwidth between replica groups, usually the
+scarcest link.
+
+The pipeline runs on a worker thread (reference `_QuantizedOpFuture`,
+collectives.py:139-156) and resolves a Work future with the reduced arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Sequence
+
+import numpy as np
+
+from torchft_tpu.ops.quantization import (
+    dequantize_fp8_rowwise,
+    quantize_fp8_rowwise,
+)
+from torchft_tpu.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.work import Future, FutureWork, Work
+
+__all__ = ["allreduce_quantized", "reduce_scatter_quantized"]
+
+_ROW = 512
+
+
+def _flatten(arrays: Sequence[Any]) -> tuple[np.ndarray, List[tuple], List[np.dtype]]:
+    hosts = [np.asarray(a) for a in arrays]
+    shapes = [h.shape for h in hosts]
+    dtypes = [h.dtype for h in hosts]
+    flat = (
+        np.concatenate([h.astype(np.float32).reshape(-1) for h in hosts])
+        if hosts
+        else np.zeros(0, np.float32)
+    )
+    return flat, shapes, dtypes
+
+
+def _unflatten(flat: np.ndarray, shapes, dtypes) -> List[np.ndarray]:
+    out = []
+    off = 0
+    for shape, dtype in zip(shapes, dtypes):
+        size = int(np.prod(shape)) if shape else 1
+        out.append(flat[off : off + size].reshape(shape).astype(dtype))
+        off += size
+    return out
+
+
+def _run_async(fn) -> Work:
+    fut: Future[Any] = Future()
+
+    def runner():
+        try:
+            fut.set_result(fn())
+        except BaseException as e:  # noqa: BLE001
+            try:
+                fut.set_exception(e)
+            except RuntimeError:
+                pass
+
+    threading.Thread(target=runner, daemon=True, name="torchft_quant_coll").start()
+    return FutureWork(fut)
+
+
+def allreduce_quantized(
+    arrays: Sequence[Any], op: ReduceOp, pg: ProcessGroup, row: int = _ROW
+) -> Work:
+    """fp8-compressed allreduce over the PG. Returns Work resolving to the
+    reduced arrays (same shapes/dtypes as inputs)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
+
+    flat, shapes, dtypes = _flatten(arrays)
+
+    def run() -> List[np.ndarray]:
+        world = pg.size()
+        if world <= 1:
+            out = flat if op == ReduceOp.SUM else flat.copy()
+            return _unflatten(out, shapes, dtypes)
+
+        # pad so every rank owns an equal chunk
+        chunk = -(-flat.size // world)
+        padded = np.zeros(chunk * world, np.float32)
+        padded[: flat.size] = flat
+
+        # quantize each destination chunk separately and alltoall
+        sends = []
+        for r in range(world):
+            q, scales, n = quantize_fp8_rowwise(padded[r * chunk : (r + 1) * chunk], row)
+            sends.append((q, scales, n))
+        recvd = pg.alltoall(sends).get_future().wait()
+
+        # local reduce in f32
+        acc = np.zeros(chunk, np.float64)
+        for q, scales, n in recvd:
+            acc[:n] += dequantize_fp8_rowwise(np.asarray(q), np.asarray(scales), n)
+        if op == ReduceOp.AVG:
+            acc /= world
+
+        # requantize the reduced chunk and allgather
+        q, scales, n = quantize_fp8_rowwise(acc.astype(np.float32), row)
+        gathered = pg.allgather([(q, scales, n)]).get_future().wait()
+
+        out = np.zeros(chunk * world, np.float32)
+        for r in range(world):
+            (qg, sg, ng) = gathered[r][0]
+            out[r * chunk : r * chunk + ng] = dequantize_fp8_rowwise(
+                np.asarray(qg), np.asarray(sg), ng
+            )
+        return _unflatten(out[: flat.size], shapes, dtypes)
+
+    return _run_async(run)
+
+
+def reduce_scatter_quantized(
+    arrays: Sequence[Any], op: ReduceOp, pg: ProcessGroup, row: int = _ROW
+) -> Work:
+    """fp8-compressed reduce-scatter: future resolves to this rank's reduced
+    flat chunk (f32) of the concatenated input."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"reduce_scatter_quantized supports SUM/AVG, got {op}")
+
+    flat, _, _ = _flatten(arrays)
+
+    def run() -> np.ndarray:
+        world = pg.size()
+        rank = pg.rank()
+        if world <= 1:
+            return flat.copy()
+        chunk = -(-flat.size // world)
+        padded = np.zeros(chunk * world, np.float32)
+        padded[: flat.size] = flat
+        sends = []
+        for r in range(world):
+            q, scales, n = quantize_fp8_rowwise(padded[r * chunk : (r + 1) * chunk], row)
+            sends.append((q, scales, n))
+        recvd = pg.alltoall(sends).get_future().wait()
+        acc = np.zeros(chunk, np.float64)
+        for q, scales, n in recvd:
+            acc[:n] += dequantize_fp8_rowwise(np.asarray(q), np.asarray(scales), n)
+        if op == ReduceOp.AVG:
+            acc /= world
+        return acc.astype(np.float32)
+
+    return _run_async(run)

@@ -1,0 +1,318 @@
+"""LocalSGD and (Streaming) DiLoCo: semi-synchronous training algorithms.
+
+Role-equivalent of the reference torchft/local_sgd.py (LocalSGD :45-172,
+_StreamingDiLoCoFragment :175-566, DiLoCo :569-795). The JAX translation is
+functional: instead of optimizer hooks mutating module parameters, the user
+threads the param pytree through ``step()`` after every inner-optimizer
+update and gets back the (possibly synced) params.
+
+Semantics preserved from the reference:
+
+- LocalSGD: every ``sync_every`` steps — quorum, allreduce(AVG) of the
+  *parameters*, commit vote; on commit adopt the average, on failure restore
+  the last synced parameters.
+- DiLoCo: inner optimizer runs locally; every ``sync_every`` steps one model
+  *fragment* syncs: pseudogradient = global(backup) - local, averaged across
+  replica groups (optionally fp8-quantized), outer optimizer steps the
+  *global* params, and the new local params are
+  ``global.lerp(local, fragment_update_alpha)``. Fragments sync round-robin,
+  staggered by ``sync_every / num_fragments`` with ``fragment_sync_delay``
+  steps of communication overlap (the "tao" of the Streaming DiLoCo paper).
+  Failed commits restore the fragment's backup so no replica over-trains.
+- DiLoCo requires synchronous quorum (use_async_quorum=False) so every
+  replica syncs the same fragment for the same manager step.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.process_group import ReduceOp
+from torchft_tpu.work import Work
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["LocalSGD", "DiLoCo", "partition_fragments"]
+
+
+def _to_host(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: np.array(x, copy=True), tree)
+
+
+def _like(template: Any, host_tree: Any) -> Any:
+    """Place host arrays back like the template leaves (device + sharding)."""
+    import jax
+
+    def place(t, h):
+        if isinstance(t, jax.Array):
+            return jax.device_put(np.asarray(h, dtype=t.dtype), t.sharding)
+        return np.asarray(h)
+
+    return jax.tree_util.tree_map(place, template, host_tree)
+
+
+class LocalSGD:
+    """Parameter-averaging LocalSGD (reference: local_sgd.py:45-172).
+
+    Usage::
+
+        local_sgd = LocalSGD(manager, params, sync_every=8)
+        for batch in data:
+            params, opt_state = inner_step(params, opt_state, batch)
+            params = local_sgd.step(params)
+    """
+
+    def __init__(self, manager: Manager, params: Any, sync_every: int) -> None:
+        assert sync_every >= 1
+        self._manager = manager
+        self._sync_every = sync_every
+        self._local_step = 0
+        self._backup = _to_host(params)
+        manager.register_state_dict_fn(
+            "LocalSGD",
+            self._load_state,
+            lambda: {"backup": self._backup},
+        )
+
+    def _load_state(self, sd: Dict[str, Any]) -> None:
+        self._backup = sd["backup"]
+
+    def step(self, params: Any) -> Any:
+        """Count an inner step; on the sync boundary average params across
+        replica groups. Returns the params to continue training with."""
+        self._local_step += 1
+        if self._local_step < self._sync_every:
+            return params
+        self._local_step = 0
+        return self._sync(params)
+
+    def _sync(self, params: Any) -> Any:
+        # No state-dict write lock here: functional updates rebind the pytree
+        # atomically, and holding the write lock across start_quorum would
+        # deadlock against the checkpoint server's read lock (the reference
+        # locks only around in-place optimizer mutation, local_sgd.py:111-123).
+        self._manager.start_quorum()
+        work = self._manager.allreduce(params, reduce_op=ReduceOp.AVG)
+        averaged = work.get_future().wait()
+        if self._manager.should_commit():
+            self._backup = _to_host(averaged)
+            return _like(params, averaged)
+        logger.warning("LocalSGD commit failed; restoring last synced params")
+        return _like(params, self._backup)
+
+
+def partition_fragments(leaves: Sequence[Any], num_fragments: int) -> List[List[int]]:
+    """Size-balanced greedy partition of leaf indices into fragments.
+
+    The reference takes explicit nn.Module fragments (user-split via torch
+    pipelining, train_diloco.py:152-158); with a flat pytree we can balance
+    automatically, and callers may still pass an explicit partition.
+    """
+    from torchft_tpu.checkpointing._serialization import split_chunks
+
+    sizes = [int(np.asarray(l).nbytes) for l in leaves]
+    frags = [sorted(c) for c in split_chunks(sizes, num_fragments)]
+    return [f for f in frags if f]
+
+
+class _Fragment:
+    """One fragment's state: global (backup) params + outer optimizer state +
+    in-flight allreduce (reference _StreamingDiLoCoFragment)."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        fragment_id: int,
+        leaf_indices: List[int],
+        leaves: List[Any],
+        outer_tx: "optax.GradientTransformation",
+        fragment_update_alpha: float,
+        should_quantize: bool,
+    ) -> None:
+        import optax  # noqa: F401  (typing only)
+
+        self._manager = manager
+        self._id = fragment_id
+        self.leaf_indices = leaf_indices
+        self._outer_tx = outer_tx
+        self._alpha = fragment_update_alpha
+        self._should_quantize = should_quantize
+
+        # global ("original") parameters live on host, like the reference's
+        # CPU backups (local_sgd.py:241-253)
+        self.original: List[np.ndarray] = [np.array(leaves[i], copy=True) for i in leaf_indices]
+        self.outer_state = outer_tx.init(self.original)
+        self._work: Optional[Work] = None
+        self._pending_grads: Optional[List[np.ndarray]] = None
+
+        manager.register_state_dict_fn(
+            f"StreamingDiLoCoFragment_{fragment_id}",
+            self._load_state,
+            self._save_state,
+        )
+
+    def _save_state(self) -> Dict[str, Any]:
+        return {
+            "original_parameters": [p.copy() for p in self.original],
+            "outer_optimizer": self.outer_state,
+        }
+
+    def _load_state(self, sd: Dict[str, Any]) -> None:
+        self.original = [np.asarray(p) for p in sd["original_parameters"]]
+        self.outer_state = sd["outer_optimizer"]
+
+    # -- sync phases ------------------------------------------------------
+    def prepare_sync(self, leaves: List[Any]) -> None:
+        """Pseudogradient = global - local, issue async averaged allreduce
+        (reference: local_sgd.py:401-420)."""
+        pseudograds = [
+            (self.original[k] - np.asarray(leaves[i])).astype(self.original[k].dtype)
+            for k, i in enumerate(self.leaf_indices)
+        ]
+        assert self._work is None, "fragment already has an allreduce in flight"
+        self._work = self._manager.allreduce(
+            pseudograds, should_quantize=self._should_quantize
+        )
+
+    def perform_sync(self, leaves: List[Any]) -> bool:
+        """Wait for the allreduce, vote, outer-step on commit
+        (reference: local_sgd.py:422-475). Mutates ``leaves`` in place with
+        the fragment's new local values. Returns should_commit."""
+        import optax
+
+        assert self._work is not None, "perform_sync before prepare_sync"
+        avg_pseudograds = self._work.get_future().wait()
+        self._work = None
+
+        # save local, restore global (rollback point)
+        local = [np.array(leaves[i], copy=True) for i in self.leaf_indices]
+        restored = list(self.original)
+
+        should_commit = self._manager.should_commit()
+        if should_commit:
+            grads = [np.asarray(g) for g in avg_pseudograds]
+            updates, self.outer_state = self._outer_tx.update(
+                grads, self.outer_state, restored
+            )
+            new_global = optax.apply_updates(restored, updates)
+            new_global = [np.asarray(p) for p in new_global]
+            self.original = [p.copy() for p in new_global]
+            # merge: global.lerp(local, alpha)
+            merged = [
+                (g + self._alpha * (l - g)).astype(g.dtype)
+                for g, l in zip(new_global, local)
+            ]
+            for k, i in enumerate(self.leaf_indices):
+                leaves[i] = merged[k]
+        else:
+            logger.warning(
+                f"DiLoCo fragment {self._id}: commit failed; restoring global params"
+            )
+            for k, i in enumerate(self.leaf_indices):
+                leaves[i] = restored[k].copy()
+        return should_commit
+
+
+class DiLoCo:
+    """Streaming DiLoCo over a param pytree (reference: local_sgd.py:569-795).
+
+    Usage::
+
+        diloco = DiLoCo(manager, params, outer_tx=optax.sgd(0.7, momentum=0.9,
+                        nesterov=True), sync_every=20, num_fragments=2)
+        for batch in data:
+            params, inner_state = inner_step(params, inner_state, batch)
+            params = diloco.step(params)
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        params: Any,
+        outer_tx: "optax.GradientTransformation",
+        sync_every: int,
+        num_fragments: int = 1,
+        fragment_partition: Optional[List[List[int]]] = None,
+        fragment_sync_delay: int = 0,
+        fragment_update_alpha: float = 0.0,
+        should_quantize: bool = False,
+    ) -> None:
+        import jax
+
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum: construct the Manager "
+                "with use_async_quorum=False"
+            )
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        if fragment_partition is None:
+            fragment_partition = partition_fragments(leaves, num_fragments)
+        num_fragments = len(fragment_partition)
+        if sync_every < num_fragments:
+            raise ValueError("only 1 fragment can be synchronized at a time")
+        if sync_every % num_fragments != 0:
+            raise ValueError("sync_every must be divisible by num_fragments")
+        # per-fragment cycle length (reference: local_sgd.py:634)
+        self._sync_every = sync_every // num_fragments
+        if fragment_sync_delay >= self._sync_every:
+            raise ValueError("fragment must sync before it is reduced again")
+        if not 0.0 <= fragment_update_alpha <= 1.0:
+            raise ValueError("fragment_update_alpha must be in [0, 1]")
+
+        self._manager = manager
+        self._local_step = 0
+        self._delay = fragment_sync_delay
+        self._fragments = [
+            _Fragment(
+                manager, i, idxs, leaves, outer_tx,
+                fragment_update_alpha, should_quantize,
+            )
+            for i, idxs in enumerate(fragment_partition)
+        ]
+
+    def _current_fragment(self) -> int:
+        # All replicas pick the fragment from the shared manager step so they
+        # never deadlock sending different fragments (reference comment,
+        # local_sgd.py:753-762).
+        return self._manager.current_step() % len(self._fragments)
+
+    def step(self, params: Any) -> Any:
+        """Advance one inner step; returns params (synced on boundaries)."""
+        import jax
+
+        self._local_step += 1
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        changed = False
+
+        if self._local_step == self._sync_every - self._delay:
+            # prepare: overlap the allreduce with the next `delay` steps
+            self._manager.start_quorum()
+            frag = self._current_fragment()
+            logger.info(f"DiLoCo: preparing fragment={frag} step={self._local_step}")
+            self._fragments[frag].prepare_sync(leaves)
+
+        if self._local_step == self._sync_every:
+            frag = self._current_fragment()
+            logger.info(
+                f"DiLoCo: syncing fragment={frag} manager_step={self._manager.current_step()}"
+            )
+            self._fragments[frag].perform_sync(leaves)
+            changed = True
+            self._local_step = 0
+
+        if not changed:
+            return params
+        host_tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        return _like(params, host_tree)
+
+    # introspection used by tests
+    @property
+    def fragments(self) -> List[_Fragment]:
+        return self._fragments

@@ -295,9 +295,12 @@ class Manager:
         )
         if not self._use_async_quorum:
             self.wait_quorum()
-            if self._healing:
+            if self._healing and self._pending_state_dict is not None:
                 # apply eagerly so the forward pass runs on recovered state
                 self._apply_pending_state_dict()
+                self._healing = False
+            elif self._healing:
+                # recovery failed (error already reported); retry next quorum
                 self._healing = False
 
     def wait_quorum(self) -> None:
@@ -538,8 +541,12 @@ class Manager:
         if (err := self._pg.errored()) is not None:
             self.report_error(err)
 
-        if self._healing:
+        if self._healing and self._pending_state_dict is not None:
             self._apply_pending_state_dict()
+        elif self._healing:
+            # recovery failed mid-flight; the error is already reported and
+            # this step will not commit — retry healing on the next quorum
+            self._healing = False
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None

@@ -1,0 +1,13 @@
+from torchft_tpu.ops.quantization import (
+    dequantize_fp8_rowwise,
+    fused_dequantize_fp8,
+    fused_quantize_fp8,
+    quantize_fp8_rowwise,
+)
+
+__all__ = [
+    "quantize_fp8_rowwise",
+    "dequantize_fp8_rowwise",
+    "fused_quantize_fp8",
+    "fused_dequantize_fp8",
+]

@@ -1,0 +1,62 @@
+"""Fault-tolerant optimizer wrapper.
+
+Role-equivalent of the reference OptimizerWrapper (torchft/optim.py:25-64):
+``zero_grad() -> start_quorum`` and ``step() only if should_commit``. The JAX
+version wraps an optax GradientTransformation: ``step`` applies the update
+only when the commit vote succeeds, otherwise returns the inputs unchanged
+(the step is discarded).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import optax
+
+from torchft_tpu.manager import Manager
+
+__all__ = ["OptimizerWrapper"]
+
+
+class OptimizerWrapper:
+    """Usage::
+
+        optimizer = OptimizerWrapper(manager, optax.adamw(3e-4))
+        opt_state = optimizer.init(params)
+        for batch in data:
+            optimizer.start_step()            # zero_grad(): starts quorum
+            grads = grad_fn(params, batch)
+            avg = manager.allreduce(grads).get_future().wait()
+            params, opt_state, committed = optimizer.step(params, opt_state, avg)
+    """
+
+    def __init__(self, manager: Manager, tx: optax.GradientTransformation) -> None:
+        self.manager = manager
+        self.tx = tx
+
+    def init(self, params: Any) -> optax.OptState:
+        return self.tx.init(params)
+
+    def start_step(self) -> None:
+        """Call at the top of the step (reference zero_grad -> start_quorum)."""
+        self.manager.start_quorum()
+
+    # alias for API parity with the reference
+    zero_grad = start_step
+
+    def step(
+        self, params: Any, opt_state: optax.OptState, grads: Any
+    ) -> Tuple[Any, optax.OptState, bool]:
+        """Apply the update iff the replica group's commit vote succeeds.
+
+        Returns (params, opt_state, committed); on a failed vote both params
+        and opt_state are returned unchanged and the step is discarded.
+        """
+        if not self.manager.should_commit():
+            return params, opt_state, False
+        import jax
+        import jax.numpy as jnp
+
+        grads = jax.tree_util.tree_map(jnp.asarray, grads)
+        updates, new_state = self.tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state, True

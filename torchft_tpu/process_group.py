@@ -84,9 +84,17 @@ def _reduce_np(op: ReduceOp, bufs: List[np.ndarray]) -> np.ndarray:
     return out
 
 
-def _to_host(x: Any) -> np.ndarray:
-    """Stage a jax.Array (or anything array-like) to host memory."""
-    return np.asarray(x)
+def _to_host(x: Any) -> Any:
+    """Stage a jax.Array (or array-like) to host memory.
+
+    Non-array payloads (e.g. the quantized collectives' (payload, scales, n)
+    tuples) pass through untouched — the wire pickles them either way.
+    """
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "__array__") and hasattr(x, "dtype"):
+        return np.asarray(x)
+    return x
 
 
 class ProcessGroup(ABC):
@@ -387,61 +395,70 @@ class ProcessGroupHost(ProcessGroup):
     process_group.py:739-763).
     """
 
+    class _Generation:
+        """One configure() generation: its mesh, dispatch queue, and error
+        state. Ops are bound to the generation they were submitted under, so
+        a late failure from a torn-down mesh can never poison (or abort) the
+        fresh one."""
+
+        def __init__(self, comm: "_Comm") -> None:
+            self.comm = comm
+            self.queue: queue.Queue = queue.Queue()
+            self.error: Optional[Exception] = None
+
+        def abort(self) -> None:
+            if self.error is None:
+                self.error = RuntimeError("process group aborted")
+            self.comm.abort()
+
     def __init__(self, timeout: "float | timedelta" = 60.0) -> None:
         super().__init__()
         self.set_timeout(timeout)
-        self._comm: Optional[_Comm] = None
-        self._error: Optional[Exception] = None
+        self._gen: Optional[ProcessGroupHost._Generation] = None
         self._rank = 0
         self._world = 1
-        self._dispatch: Optional[queue.Queue] = None
-        self._dispatch_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
 
     # -- lifecycle --------------------------------------------------------
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        comm = _Comm(
+            rank=replica_rank,
+            world=replica_world_size,
+            store_addr=store_addr,
+            quorum_id=quorum_id,
+            timeout=self._timeout,
+        )
+        gen = ProcessGroupHost._Generation(comm)
         with self._lock:
-            self._teardown_locked()
-            self._comm = _Comm(
-                rank=replica_rank,
-                world=replica_world_size,
-                store_addr=store_addr,
-                quorum_id=quorum_id,
-                timeout=self._timeout,
-            )
+            old, self._gen = self._gen, gen
             self._rank = replica_rank
             self._world = replica_world_size
-            self._error = None
-            self._dispatch = queue.Queue()
-            self._dispatch_thread = threading.Thread(
-                target=self._dispatch_loop,
-                args=(self._dispatch,),
-                daemon=True,
-                name=f"pg_host_dispatch_r{replica_rank}",
-            )
-            self._dispatch_thread.start()
-
-    def _teardown_locked(self) -> None:
-        if self._comm is not None:
-            self._comm.abort()
-            self._comm = None
-        if self._dispatch is not None:
-            self._dispatch.put(None)  # poison pill
-            self._dispatch = None
+        if old is not None:
+            old.abort()
+            old.queue.put(None)
+        threading.Thread(
+            target=self._dispatch_loop,
+            args=(gen,),
+            daemon=True,
+            name=f"pg_host_dispatch_r{replica_rank}",
+        ).start()
 
     def abort(self) -> None:
         with self._lock:
-            if self._comm is not None:
-                self._comm.abort()
-            if self._error is None:
-                self._error = RuntimeError("process group aborted")
+            gen = self._gen
+        if gen is not None:
+            gen.abort()
 
     def shutdown(self) -> None:
         with self._lock:
-            self._teardown_locked()
+            gen, self._gen = self._gen, None
+        if gen is not None:
+            gen.abort()
+            gen.queue.put(None)
 
     def errored(self) -> Optional[Exception]:
-        return self._error
+        with self._lock:
+            return self._gen.error if self._gen is not None else None
 
     def size(self) -> int:
         return self._world
@@ -450,39 +467,39 @@ class ProcessGroupHost(ProcessGroup):
         return self._rank
 
     # -- dispatch ---------------------------------------------------------
-    def _dispatch_loop(self, q: queue.Queue) -> None:
+    def _dispatch_loop(self, gen: "ProcessGroupHost._Generation") -> None:
         while True:
-            item = q.get()
+            item = gen.queue.get()
             if item is None:
                 return
             fn, fut = item
             try:
-                with context_timeout(self.abort, self._timeout):
-                    fut.set_result(fn())
+                # the watchdog aborts THIS generation's mesh only
+                with context_timeout(gen.abort, self._timeout):
+                    fut.set_result(fn(gen.comm))
             except BaseException as e:  # noqa: BLE001
-                self._error = e if isinstance(e, Exception) else RuntimeError(str(e))
+                gen.error = e if isinstance(e, Exception) else RuntimeError(str(e))
                 try:
                     fut.set_exception(e)
                 except RuntimeError:
                     pass
 
-    def _submit(self, fn: Callable[[], Any]) -> Work:
+    def _submit(self, fn: Callable[["_Comm"], Any]) -> Work:
         with self._lock:
-            if self._comm is None or self._dispatch is None:
+            gen = self._gen
+            if gen is None:
                 raise RuntimeError("process group is not configured")
-            if self._error is not None:
-                raise self._error
+            if gen.error is not None:
+                raise gen.error
             fut: Future[Any] = Future()
-            self._dispatch.put((fn, fut))
+            gen.queue.put((fn, fut))
             return FutureWork(fut)
 
     # -- collectives ------------------------------------------------------
     def allreduce(self, arrays, op=ReduceOp.SUM):
         host = [_to_host(a) for a in arrays]
 
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             if comm.world == 1:
                 return host if op != ReduceOp.AVG else [h.copy() for h in host]
             payload = {r: host for r in range(comm.world) if r != comm.rank}
@@ -497,9 +514,7 @@ class ProcessGroupHost(ProcessGroup):
     def allgather(self, arrays):
         host = [_to_host(a) for a in arrays]
 
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             if comm.world == 1:
                 return [host]
             gathered = comm.exchange(
@@ -512,9 +527,7 @@ class ProcessGroupHost(ProcessGroup):
     def broadcast(self, arrays, root=0):
         host = [_to_host(a) for a in arrays]
 
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             if comm.world == 1:
                 return host
             if comm.rank == root:
@@ -529,9 +542,7 @@ class ProcessGroupHost(ProcessGroup):
     def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
         host = [[_to_host(a) for a in chunk] for chunk in input_chunks]
 
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             if comm.world == 1:
                 return host[0]
             assert len(host) == comm.world, "need one chunk per rank"
@@ -547,9 +558,7 @@ class ProcessGroupHost(ProcessGroup):
     def alltoall(self, input_chunks):
         host = [_to_host(a) for a in input_chunks]
 
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             if comm.world == 1:
                 return host
             assert len(host) == comm.world, "need one chunk per rank"
@@ -561,18 +570,14 @@ class ProcessGroupHost(ProcessGroup):
     def send(self, arrays, dst, tag=0):
         host = [_to_host(a) for a in arrays]
 
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             comm.send_to(dst, ("p2p", tag, host))
             return None
 
         return self._submit(_run)
 
     def recv(self, src, tag=0):
-        def _run():
-            comm = self._comm
-            assert comm is not None
+        def _run(comm):
             kind, got_tag, host = comm.recv_from(src)
             assert kind == "p2p" and got_tag == tag, (kind, got_tag, tag)
             return host
@@ -782,7 +787,7 @@ class ManagedProcessGroup(ProcessGroup):
         self._manager = manager
 
     def allreduce(self, arrays, op=ReduceOp.SUM):
-        return self._manager.allreduce(list(arrays))
+        return self._manager.allreduce(list(arrays), reduce_op=op)
 
     def size(self) -> int:
         return self._manager.num_participants()

@@ -57,8 +57,6 @@ def train(args) -> None:
         logits = forward(params, x)
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
-
     params = init_params(jax.random.PRNGKey(replica_id))
     inner_tx = optax.adamw(1e-3)
     inner_state = inner_tx.init(params)
@@ -93,14 +91,13 @@ def train(args) -> None:
     )
 
     rng = np.random.RandomState(replica_id)
-    inner_step = jax.jit(
-        lambda params, opt_state, x, y: _inner(params, opt_state, x, y)
-    )
 
     def _inner(params, opt_state, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         updates, opt_state = inner_tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
+
+    inner_step = jax.jit(_inner)
 
     target_outer_steps = args.steps // args.sync_every * args.num_fragments
     local = 0

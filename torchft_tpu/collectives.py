@@ -68,6 +68,28 @@ def _run_async(fn) -> Work:
     return FutureWork(fut)
 
 
+def _reduce_scatter_core(
+    flat: np.ndarray, op: ReduceOp, pg: ProcessGroup, row: int
+) -> tuple[np.ndarray, int]:
+    """Shared pipeline: pad -> per-dest-chunk quantize -> alltoall -> f32
+    accumulate (-> AVG). Returns (this rank's reduced f32 chunk, chunk size)."""
+    world = pg.size()
+    chunk = -(-flat.size // world)
+    padded = np.zeros(chunk * world, np.float32)
+    padded[: flat.size] = flat
+    sends = []
+    for r in range(world):
+        q, scales, n = quantize_fp8_rowwise(padded[r * chunk : (r + 1) * chunk], row)
+        sends.append((q, scales, n))
+    recvd = pg.alltoall(sends).get_future().wait()
+    acc = np.zeros(chunk, np.float64)
+    for q, scales, n in recvd:
+        acc[:n] += dequantize_fp8_rowwise(np.asarray(q), np.asarray(scales), n)
+    if op == ReduceOp.AVG:
+        acc /= world
+    return acc.astype(np.float32), chunk
+
+
 def allreduce_quantized(
     arrays: Sequence[Any], op: ReduceOp, pg: ProcessGroup, row: int = _ROW
 ) -> Work:
@@ -84,27 +106,10 @@ def allreduce_quantized(
             out = flat if op == ReduceOp.SUM else flat.copy()
             return _unflatten(out, shapes, dtypes)
 
-        # pad so every rank owns an equal chunk
-        chunk = -(-flat.size // world)
-        padded = np.zeros(chunk * world, np.float32)
-        padded[: flat.size] = flat
-
-        # quantize each destination chunk separately and alltoall
-        sends = []
-        for r in range(world):
-            q, scales, n = quantize_fp8_rowwise(padded[r * chunk : (r + 1) * chunk], row)
-            sends.append((q, scales, n))
-        recvd = pg.alltoall(sends).get_future().wait()
-
-        # local reduce in f32
-        acc = np.zeros(chunk, np.float64)
-        for q, scales, n in recvd:
-            acc[:n] += dequantize_fp8_rowwise(np.asarray(q), np.asarray(scales), n)
-        if op == ReduceOp.AVG:
-            acc /= world
+        acc, chunk = _reduce_scatter_core(flat, op, pg, row)
 
         # requantize the reduced chunk and allgather
-        q, scales, n = quantize_fp8_rowwise(acc.astype(np.float32), row)
+        q, scales, n = quantize_fp8_rowwise(acc, row)
         gathered = pg.allgather([(q, scales, n)]).get_future().wait()
 
         out = np.zeros(chunk * world, np.float32)
@@ -129,23 +134,9 @@ def reduce_scatter_quantized(
     flat, _, _ = _flatten(arrays)
 
     def run() -> np.ndarray:
-        world = pg.size()
-        rank = pg.rank()
-        if world <= 1:
+        if pg.size() <= 1:
             return flat.copy()
-        chunk = -(-flat.size // world)
-        padded = np.zeros(chunk * world, np.float32)
-        padded[: flat.size] = flat
-        sends = []
-        for r in range(world):
-            q, scales, n = quantize_fp8_rowwise(padded[r * chunk : (r + 1) * chunk], row)
-            sends.append((q, scales, n))
-        recvd = pg.alltoall(sends).get_future().wait()
-        acc = np.zeros(chunk, np.float64)
-        for q, scales, n in recvd:
-            acc[:n] += dequantize_fp8_rowwise(np.asarray(q), np.asarray(scales), n)
-        if op == ReduceOp.AVG:
-            acc /= world
-        return acc.astype(np.float32)
+        acc, _ = _reduce_scatter_core(flat, op, pg, row)
+        return acc
 
     return _run_async(run)

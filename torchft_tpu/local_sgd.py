@@ -298,19 +298,29 @@ class DiLoCo:
             logger.info(f"DiLoCo: preparing fragment={frag} step={self._local_step}")
             self._fragments[frag].prepare_sync(leaves)
 
+        changed_indices: List[int] = []
         if self._local_step == self._sync_every:
             frag = self._current_fragment()
             logger.info(
                 f"DiLoCo: syncing fragment={frag} manager_step={self._manager.current_step()}"
             )
             self._fragments[frag].perform_sync(leaves)
-            changed = True
+            changed_indices = self._fragments[frag].leaf_indices
             self._local_step = 0
 
-        if not changed:
+        if not changed_indices:
             return params
-        host_tree = jax.tree_util.tree_unflatten(treedef, leaves)
-        return _like(params, host_tree)
+        # Re-place only the synced fragment's leaves; the other fragments'
+        # jax.Arrays pass through untouched (streaming DiLoCo's point is that
+        # a sync boundary touches 1/num_fragments of the model).
+        orig_leaves = jax.tree_util.tree_leaves(params)
+        for i in changed_indices:
+            orig = orig_leaves[i]
+            if isinstance(orig, jax.Array):
+                leaves[i] = jax.device_put(
+                    np.asarray(leaves[i], dtype=orig.dtype), orig.sharding
+                )
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # introspection used by tests
     @property

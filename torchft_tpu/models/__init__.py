@@ -1,0 +1,8 @@
+from torchft_tpu.models.llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+)
+
+__all__ = ["LlamaConfig", "llama_init", "llama_forward", "llama_loss"]

@@ -1,0 +1,215 @@
+"""Llama-3-family transformer, TPU-first functional JAX.
+
+The flagship model family for fault-tolerant HSDP/DiLoCo training (the
+reference trains Llama-3-8B via torchtitan, examples/slurm/runner.py:23-60;
+here the model is in-tree because the rebuild is a standalone framework).
+
+Design for the TPU:
+- params and activations in bfloat16, RMSNorm/softmax accumulation in f32
+  (MXU-friendly matmuls, VPU-safe reductions)
+- GQA attention with RoPE; SwiGLU MLP; pre-norm; weight-tied off by default
+- pure functions of a params pytree: `jit`/`pjit` them under any Mesh; the
+  sharding rules for tp/fsdp axes live in torchft_tpu/parallel/mesh.py
+- no data-dependent Python control flow — everything traces once
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "LlamaConfig",
+    "llama_init",
+    "llama_forward",
+    "llama_loss",
+    "CONFIGS",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        d, h, v, L = self.dim, self.ffn_hidden, self.vocab_size, self.n_layers
+        kv = self.n_kv_heads * self.head_dim
+        per_layer = d * d + 2 * d * kv + d * d + 3 * d * h + 2 * d
+        return L * per_layer + 2 * v * d + d
+
+
+CONFIGS: Dict[str, LlamaConfig] = {
+    # debug/tiny for tests and compile checks
+    "debug": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=128, max_seq_len=128, dtype=jnp.float32,
+    ),
+    "tiny": LlamaConfig(
+        vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        ffn_hidden=688, max_seq_len=1024,
+    ),
+    # ~410M params: single-v5e-chip bench config
+    "bench_420m": LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        ffn_hidden=2816, max_seq_len=2048,
+    ),
+    # Llama-3-8B (reference target config, examples/slurm/runner.py)
+    "llama3_8b": LlamaConfig(
+        vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        ffn_hidden=14336, max_seq_len=8192,
+    ),
+    # Llama-3-70B (reference v5p-256 config)
+    "llama3_70b": LlamaConfig(
+        vocab_size=128256, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        ffn_hidden=28672, max_seq_len=8192,
+    ),
+}
+
+
+def llama_init(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Initialize the parameter pytree.
+
+    Layers are stacked along a leading axis so the forward pass can
+    ``lax.scan`` over them — one compiled layer body regardless of depth
+    (fast compiles, friendly to pipeline sharding).
+    """
+    k_emb, k_out, k_layers = jax.random.split(key, 3)
+    d, hd = cfg.dim, cfg.head_dim
+    kvd = cfg.n_kv_heads * hd
+    L = cfg.n_layers
+
+    def norm_init(*shape):
+        return jnp.ones(shape, cfg.dtype)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": norm_init(L, d),
+        "wq": dense_init(ks[0], (L, d, cfg.n_heads * hd), d),
+        "wk": dense_init(ks[1], (L, d, kvd), d),
+        "wv": dense_init(ks[2], (L, d, kvd), d),
+        "wo": dense_init(ks[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+        "ffn_norm": norm_init(L, d),
+        "w_gate": dense_init(ks[4], (L, d, cfg.ffn_hidden), d),
+        "w_up": dense_init(ks[5], (L, d, cfg.ffn_hidden), d),
+        "w_down": dense_init(ks[6], (L, cfg.ffn_hidden, d), cfg.ffn_hidden),
+    }
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": norm_init(d),
+        "lm_head": dense_init(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * w
+
+
+def _rope(x: jax.Array, theta: float, positions: jax.Array) -> jax.Array:
+    """Rotary embeddings; x: [B, S, H, hd]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,S,1,hd/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, cfg: LlamaConfig
+) -> jax.Array:
+    """Causal GQA attention. q: [B,S,Hq,hd], k/v: [B,S,Hkv,hd]."""
+    B, S, Hq, hd = q.shape
+    groups = Hq // k.shape[2]
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def llama_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    attention_fn: Optional[Any] = None,
+    remat: bool = True,
+) -> jax.Array:
+    """tokens: int32 [B, S] -> logits f32 [B, S, vocab].
+
+    ``attention_fn(q, k, v, cfg)`` can be swapped for a sharded/ring variant
+    (torchft_tpu/parallel/ring_attention.py) without touching the rest of the
+    stack.
+
+    ``remat`` checkpoints each layer: the backward pass recomputes
+    activations instead of saving every layer's S x S attention residuals —
+    the standard HBM-for-FLOPs trade that makes long sequences fit
+    (jax.checkpoint over the scanned layer body).
+    """
+    attention = attention_fn or _attention
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    h = params["embed"][tokens]  # [B,S,D]
+
+    def layer(h, layer_params):
+        x = _rmsnorm(h, layer_params["attn_norm"], cfg.norm_eps)
+        q = (x @ layer_params["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        k = (x @ layer_params["wk"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        v = (x @ layer_params["wv"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+        attn = attention(q, k, v, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
+        h = h + attn @ layer_params["wo"]
+        x = _rmsnorm(h, layer_params["ffn_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(x @ layer_params["w_gate"]) * (x @ layer_params["w_up"])
+        h = h + gated @ layer_params["w_down"]
+        return h, None
+
+    # scan over stacked layers: one compiled body, L iterations
+    body = jax.checkpoint(layer) if remat else layer
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    return logits
+
+
+def llama_loss(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: LlamaConfig,
+    attention_fn: Optional[Any] = None,
+) -> jax.Array:
+    """Mean next-token cross-entropy."""
+    logits = llama_forward(params, tokens, cfg, attention_fn=attention_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -1,0 +1,18 @@
+from torchft_tpu.parallel.mesh import (
+    batch_sharding,
+    llama_param_specs,
+    make_hsdp_mesh,
+    make_train_step,
+    shard_params,
+)
+from torchft_tpu.parallel.ring_attention import make_ring_attention_fn, ring_attention
+
+__all__ = [
+    "make_hsdp_mesh",
+    "llama_param_specs",
+    "batch_sharding",
+    "shard_params",
+    "make_train_step",
+    "ring_attention",
+    "make_ring_attention_fn",
+]

@@ -1,0 +1,129 @@
+"""Device mesh + sharding rules: the intra-replica-group parallelism plane.
+
+The reference delegates FSDP/TP/PP inside a replica group to
+torchtitan/PyTorch composables and owns only the replicated dim
+(reference README.md:40, fsdp_test.py:57-72). On TPU the equivalent is XLA
+SPMD: pick a Mesh, annotate shardings, let XLA insert the collectives over
+ICI. This module provides the mesh and the HSDP sharding rules for the
+in-tree Llama family:
+
+- axes: ``dp`` (fault-tolerant replicated dim — maps across replica groups /
+  DCN), ``fsdp`` (ZeRO-style parameter sharding), ``tp`` (Megatron-style
+  tensor parallel), ``sp`` (sequence/context parallel for ring attention)
+- params: column-then-row tp sharding of attention/FFN matmuls, fsdp on the
+  other dim; XLA inserts the all-gathers/reduce-scatters
+- batch: sharded over (dp, fsdp); sequence over sp
+
+The FT allreduce of torchft_tpu.manager applies across replica *groups* on
+the host plane; within a single-controller multi-chip job the ``dp`` axis of
+this mesh plays that role in-graph.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models.llama import LlamaConfig
+
+__all__ = [
+    "make_hsdp_mesh",
+    "llama_param_specs",
+    "shard_params",
+    "batch_sharding",
+    "make_train_step",
+]
+
+
+def make_hsdp_mesh(
+    devices=None, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1
+) -> Mesh:
+    """Build a 4-axis mesh. Axis order is outermost-first: dp rides the
+    slowest links (DCN between replica groups), sp/tp the fastest (ICI)."""
+    devices = devices if devices is not None else jax.devices()
+    n = dp * fsdp * sp * tp
+    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, ("dp", "fsdp", "sp", "tp"))
+
+
+def llama_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpecs matching the llama_init pytree (HSDP + TP).
+
+    Column-parallel projections (wq/wk/wv/w_gate/w_up) shard their output dim
+    over tp; row-parallel (wo/w_down) shard their input dim over tp — XLA
+    turns the seam into one psum per block, the Megatron pattern. The
+    remaining big dim shards over fsdp (ZeRO-3).
+    """
+    return {
+        "embed": P("fsdp", "tp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "ffn_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }
+
+
+def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
+    """device_put every leaf onto its NamedSharding."""
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), params, specs
+    )
+
+
+def batch_sharding(mesh: Mesh, with_sp: bool = True) -> NamedSharding:
+    """Tokens [B, S]: batch over (dp, fsdp), sequence over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp" if with_sp else None))
+
+
+def make_train_step(
+    cfg: LlamaConfig,
+    tx: Any,  # optax.GradientTransformation
+    mesh: Mesh,
+    attention_fn: Optional[Callable] = None,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted HSDP train step.
+
+    Gradients are implicitly summed across dp/fsdp by XLA (the loss mean over
+    the batch spans those axes); params/opt state stay in their HSDP
+    sharding. Returns fn(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss).
+    """
+    import optax
+
+    from torchft_tpu.models.llama import llama_loss
+
+    specs = llama_param_specs(cfg)
+    param_shardings = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs
+    )
+    tok_sharding = batch_sharding(mesh)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(llama_loss)(
+            params, tokens, targets, cfg, attention_fn=attention_fn
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(param_shardings, None, tok_sharding, tok_sharding),
+        out_shardings=(param_shardings, None, None),
+        donate_argnums=(0, 1) if donate else (),
+    )

@@ -87,14 +87,15 @@ def _reduce_np(op: ReduceOp, bufs: List[np.ndarray]) -> np.ndarray:
 def _to_host(x: Any) -> Any:
     """Stage a jax.Array (or array-like) to host memory.
 
-    Non-array payloads (e.g. the quantized collectives' (payload, scales, n)
-    tuples) pass through untouched — the wire pickles them either way.
+    Tuples pass through untouched (the quantized collectives ship
+    (payload, scales, n) tuples); everything else — including plain Python
+    lists — is coerced to ndarray so the reduce math is well-defined.
     """
     if isinstance(x, np.ndarray):
         return x
-    if hasattr(x, "__array__") and hasattr(x, "dtype"):
-        return np.asarray(x)
-    return x
+    if isinstance(x, tuple):
+        return x
+    return np.asarray(x)
 
 
 class ProcessGroup(ABC):

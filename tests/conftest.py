@@ -2,23 +2,20 @@
 
 Multi-chip TPU hardware is not available in CI; sharding/mesh tests run on a
 virtual 8-device CPU backend (the same mechanism the driver's
-``dryrun_multichip`` uses). Must run before the first ``jax`` import in any
-test module.
+``dryrun_multichip`` uses). Must run before the first JAX backend
+initialisation in any test module.
 """
 
 import os
+import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu.utils import force_virtual_cpu_devices  # noqa: E402
+
+force_virtual_cpu_devices(8)
 
 import jax  # noqa: E402
-
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
-
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,55 @@
+"""Accelerator/platform helpers (reference: torchft utils.py:17-67).
+
+The reference's utils provide stream-context and event helpers for
+cuda/xpu; on TPU, JAX's async dispatch replaces user-managed streams, so the
+helpers here cover the platform concerns this framework actually has:
+forcing a virtual multi-device CPU platform for tests and dry runs, and
+blocking on device work.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu_devices(n: int) -> None:
+    """Force a virtual ``n``-device CPU platform.
+
+    Must run before the first JAX backend initialisation (importing jax is
+    fine — ``XLA_FLAGS`` is read at backend-init time). Overrides any
+    pre-existing smaller device-count flag, and flips ``jax_platforms`` to
+    cpu because platform plugins (e.g. a tunnelled single TPU chip) can take
+    precedence over ``JAX_PLATFORMS=cpu`` in the environment.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _FLAG in flags:
+        def _bump(m: "re.Match[str]") -> str:
+            return f"--{_FLAG}={max(n, int(m.group(1)))}"
+
+        flags = re.sub(rf"--{_FLAG}=(\d+)", _bump, flags)
+    else:
+        flags = f"{flags} --{_FLAG}={n}".strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialised; caller's device check reports it
+
+
+def synchronize(tree: Any) -> Any:
+    """Block until every array in ``tree`` has been computed.
+
+    The analog of the reference's ``utils.synchronize`` (utils.py:58-67):
+    JAX dispatch is async, so callers that need a host-visible completion
+    point (commit gates, timing) block on the arrays themselves.
+    """
+    import jax
+
+    return jax.block_until_ready(tree)

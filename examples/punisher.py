@@ -1,0 +1,108 @@
+"""Chaos tool: kill replicas through the lighthouse to exercise fault
+tolerance (reference: examples/slurm/punisher.py).
+
+Modes:
+  kill_one   — kill one (random or named) replica and exit
+  kill_all   — kill every replica in the current quorum
+  kill_loop  — Poisson process of kills with the given MTBF until stopped
+
+The lighthouse serves ``/status`` (JSON: participants + heartbeat ages) and
+``POST /replica/{id}/kill`` which forwards a Kill RPC to the replica's
+manager (native/lighthouse.cc handle_http); managers exit(1) on kill, and
+the launcher/torchelastic equivalent restarts them — the quorum shrinks and
+re-grows while training keeps going.
+
+    python examples/punisher.py --lighthouse 127.0.0.1:29510 kill_one
+    python examples/punisher.py --lighthouse 127.0.0.1:29510 kill_loop --mtbf 60
+"""
+
+import argparse
+import json
+import random
+import sys
+import time
+import urllib.request
+
+
+def _base(addr: str) -> str:
+    return addr if addr.startswith("http") else f"http://{addr}"
+
+
+def list_replicas(lighthouse: str) -> list:
+    with urllib.request.urlopen(f"{_base(lighthouse)}/status", timeout=10) as r:
+        status = json.loads(r.read().decode())
+    ids = {p["replica_id"] for p in status.get("participants", [])}
+    if status.get("prev_quorum"):
+        ids |= {p["replica_id"] for p in status["prev_quorum"].get("participants", [])}
+    return sorted(ids)
+
+
+def kill(lighthouse: str, replica_id: str) -> bool:
+    req = urllib.request.Request(
+        f"{_base(lighthouse)}/replica/{replica_id}/kill", method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            print(f"killed {replica_id}: {r.read().decode().strip()}", flush=True)
+        return True
+    except urllib.error.HTTPError as e:
+        print(f"kill {replica_id} failed: {e}", file=sys.stderr, flush=True)
+        return False
+
+
+def kill_one(lighthouse: str, replica_id: "str | None" = None) -> int:
+    replicas = list_replicas(lighthouse)
+    if not replicas:
+        print("no replicas known to the lighthouse", file=sys.stderr)
+        return 1
+    victim = replica_id if replica_id is not None else random.choice(replicas)
+    return 0 if kill(lighthouse, victim) else 1
+
+
+def kill_all(lighthouse: str) -> int:
+    replicas = list_replicas(lighthouse)
+    rc = 0
+    for r in replicas:
+        rc |= 0 if kill(lighthouse, r) else 1
+    return rc
+
+
+def kill_loop(lighthouse: str, mtbf: float, max_kills: int = 0) -> int:
+    """Exponentially distributed inter-kill times with mean ``mtbf`` seconds
+    (reference punisher's MTBF loop)."""
+    kills = 0
+    while max_kills <= 0 or kills < max_kills:
+        delay = random.expovariate(1.0 / mtbf)
+        print(f"next kill in {delay:.1f}s", flush=True)
+        time.sleep(delay)
+        try:
+            if kill_one(lighthouse) == 0:
+                kills += 1
+        except Exception as e:  # noqa: BLE001 — lighthouse may be mid-restart
+            print(f"kill attempt failed: {e}", file=sys.stderr, flush=True)
+    return 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--lighthouse", required=True, help="host:port")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    one = sub.add_parser("kill_one")
+    one.add_argument("--replica-id", default=None)
+    sub.add_parser("kill_all")
+    loop = sub.add_parser("kill_loop")
+    loop.add_argument("--mtbf", type=float, default=60.0,
+                      help="mean seconds between kills")
+    loop.add_argument("--max-kills", type=int, default=0, help="0 = forever")
+    args = parser.parse_args()
+
+    if args.cmd == "kill_one":
+        sys.exit(kill_one(args.lighthouse, args.replica_id))
+    elif args.cmd == "kill_all":
+        sys.exit(kill_all(args.lighthouse))
+    else:
+        sys.exit(kill_loop(args.lighthouse, args.mtbf, args.max_kills))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def train(args) -> None:
+    if args.virtual_chips:
+        # local multi-process runs share no TPU; use a virtual CPU platform
+        from torchft_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.virtual_chips)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -131,7 +136,9 @@ def demo(args) -> None:
     def spawn(rid):
         env = dict(os.environ, TORCHFT_LIGHTHOUSE=addr, REPLICA_GROUP_ID=str(rid))
         return subprocess.Popen(
-            [sys.executable, __file__, "--steps", str(args.steps)], env=env
+            [sys.executable, __file__, "--steps", str(args.steps),
+             "--virtual-chips", "1"],
+            env=env,
         )
 
     procs = {rid: spawn(rid) for rid in range(args.replicas)}
@@ -160,6 +167,8 @@ if __name__ == "__main__":
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--replica-id", type=int, default=0)
     parser.add_argument("--lighthouse", type=str, default="127.0.0.1:29510")
+    parser.add_argument("--virtual-chips", type=int, default=0,
+                        help="force N virtual CPU devices (local multi-process runs)")
     parser.add_argument("--demo", action="store_true")
     parser.add_argument("--replicas", type=int, default=2)
     parser.add_argument("--kill-after", type=float, default=6.0)

@@ -1,0 +1,106 @@
+"""Launcher + lighthouse CLI tests (reference: torchx.py contract)."""
+
+import json
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import pytest
+
+from torchft_tpu.launcher import (
+    GROUP_RANK_ENV,
+    LIGHTHOUSE_ENV,
+    NUM_REPLICA_GROUPS_ENV,
+    REPLICA_GROUP_ID_ENV,
+    launch_replica_groups,
+)
+
+WORKER_OK = textwrap.dedent(
+    f"""
+    import os, sys
+    assert ":" in os.environ["{LIGHTHOUSE_ENV}"]  # host:port
+    rid = int(os.environ["{REPLICA_GROUP_ID_ENV}"])
+    n = int(os.environ["{NUM_REPLICA_GROUPS_ENV}"])
+    assert 0 <= rid < n
+    assert os.environ["{GROUP_RANK_ENV}"] == "0"
+    print("worker", rid, "of", n, flush=True)
+    """
+)
+
+WORKER_FLAKY = textwrap.dedent(
+    f"""
+    import os, sys, pathlib
+    rid = os.environ["{REPLICA_GROUP_ID_ENV}"]
+    marker = pathlib.Path(sys.argv[1]) / ("died_" + rid)
+    if rid == "1" and not marker.exists():
+        marker.write_text("x")
+        sys.exit(3)   # first attempt of group 1 crashes
+    sys.exit(0)
+    """
+)
+
+
+def _script(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+def test_launch_env_contract(tmp_path):
+    code = launch_replica_groups(
+        [sys.executable, _script(tmp_path, "ok.py", WORKER_OK)],
+        num_groups=2,
+        poll_interval=0.2,
+    )
+    assert code == 0
+
+
+def test_launch_restarts_failed_group(tmp_path):
+    script = _script(tmp_path, "flaky.py", WORKER_FLAKY)
+    code = launch_replica_groups(
+        [sys.executable, script, str(tmp_path)],
+        num_groups=2,
+        max_restarts=1,
+        poll_interval=0.2,
+    )
+    assert code == 0
+    assert (tmp_path / "died_1").exists()
+
+
+def test_launch_out_of_restarts_fails(tmp_path):
+    script = _script(
+        tmp_path, "dead.py", "import sys; sys.exit(2)"
+    )
+    code = launch_replica_groups(
+        [sys.executable, script],
+        num_groups=1,
+        max_restarts=0,
+        poll_interval=0.2,
+    )
+    assert code == 1
+
+
+def test_lighthouse_cli_and_dashboard():
+    """Boot the CLI in a subprocess, hit /status, then terminate."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "torchft_tpu.lighthouse", "--bind", "127.0.0.1:0"],
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        addr = None
+        for _ in range(100):
+            line = proc.stderr.readline()
+            if "listening at" in line:
+                addr = line.rsplit(" ", 1)[-1].strip()
+                break
+        assert addr, "lighthouse did not report its address"
+        if not addr.startswith("http"):
+            addr = f"http://{addr}"
+        with urllib.request.urlopen(f"{addr}/status", timeout=10) as resp:
+            status = json.loads(resp.read().decode())
+        assert "participants" in status or "quorum_id" in status
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

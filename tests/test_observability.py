@@ -1,0 +1,81 @@
+"""Tests for structured event logging + trace spans (observability.py)."""
+
+import json
+import logging
+
+from torchft_tpu.observability import (
+    COMMIT_EVENTS,
+    ERROR_EVENTS,
+    QUORUM_EVENTS,
+    get_event_logger,
+    log_commit_event,
+    log_error_event,
+    log_quorum_event,
+    trace_span,
+)
+
+
+def _capture(caplog, name, fn, **fields):
+    with caplog.at_level(logging.INFO, logger=name):
+        fn(**fields)
+    records = [r for r in caplog.records if r.name == name]
+    assert len(records) == 1
+    payload = json.loads(records[0].getMessage())
+    assert "event_time" in payload
+    return payload
+
+
+def test_quorum_event_structured(caplog):
+    payload = _capture(
+        caplog, QUORUM_EVENTS, log_quorum_event, quorum_id=3, replica_rank=1
+    )
+    assert payload["quorum_id"] == 3
+    assert payload["replica_rank"] == 1
+
+
+def test_commit_event_structured(caplog):
+    payload = _capture(
+        caplog, COMMIT_EVENTS, log_commit_event, step=7, committed=True
+    )
+    assert payload["step"] == 7
+    assert payload["committed"] is True
+
+
+def test_error_event_serializes_exceptions(caplog):
+    payload = _capture(
+        caplog, ERROR_EVENTS, log_error_event, error=ValueError("boom")
+    )
+    assert "boom" in payload["error"]
+
+
+def test_event_logger_cached():
+    assert get_event_logger("x_stream") is get_event_logger("x_stream")
+
+
+def test_trace_span_noop_and_with_jax():
+    # must not raise with or without an active profiler
+    with trace_span("torchft::test::span"):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_manager_events_emitted_on_report_error(caplog):
+    """Manager.report_error should emit a torchft_errors record."""
+    from torchft_tpu.manager import Manager
+
+    # Construct a Manager shell without running __init__ networking.
+    m = Manager.__new__(Manager)
+    m._errored = None
+    m._replica_id = "test:0"
+    m._group_rank = 0
+    m._step = 5
+    m._quorum_id = 2
+
+    with caplog.at_level(logging.INFO, logger=ERROR_EVENTS):
+        m.report_error(RuntimeError("injected"))
+    records = [r for r in caplog.records if r.name == ERROR_EVENTS]
+    assert len(records) == 1
+    payload = json.loads(records[0].getMessage())
+    assert payload["step"] == 5
+    assert "injected" in payload["error"]
+    assert m.errored() is not None

@@ -1,0 +1,62 @@
+"""ParameterServer session tests (reference: parameter_server.py usage)."""
+
+import numpy as np
+import pytest
+
+from torchft_tpu.parameter_server import ParameterServer
+from torchft_tpu.process_group import ReduceOp
+
+
+class _EchoPS(ParameterServer):
+    """Serves a fixed parameter vector, then accumulates one gradient push."""
+
+    def __init__(self, params: np.ndarray, **kw: object) -> None:
+        self.params = params
+        self.grads: list[np.ndarray] = []
+        super().__init__(**kw)  # type: ignore[arg-type]
+
+    def forward(self, rank: int, pg) -> None:
+        out = pg.broadcast([self.params.copy()], root=0).get_future().wait()
+        del out
+        grad = np.zeros_like(self.params)
+        (g,) = pg.allreduce([grad], ReduceOp.SUM).get_future().wait()
+        self.grads.append(g)
+
+
+@pytest.fixture()
+def ps():
+    server = _EchoPS(np.arange(8.0))
+    yield server
+    server.shutdown()
+
+
+def test_session_broadcast_and_push(ps):
+    pg = ParameterServer.new_session(ps.address(), timeout=30.0)
+    try:
+        (got,) = pg.broadcast([np.zeros(8)], root=0).get_future().wait()
+        np.testing.assert_array_equal(got, np.arange(8.0))
+
+        push = np.full(8, 2.0)
+        (reduced,) = pg.allreduce([push], ReduceOp.SUM).get_future().wait()
+        np.testing.assert_array_equal(reduced, push)  # server contributed zeros
+    finally:
+        pg.shutdown()
+    assert len(ps.grads) == 1
+    np.testing.assert_array_equal(ps.grads[0], np.full(8, 2.0))
+
+
+def test_sessions_are_isolated(ps):
+    pg1 = ParameterServer.new_session(ps.address(), timeout=30.0)
+    (got,) = pg1.broadcast([np.zeros(8)], root=0).get_future().wait()
+    np.testing.assert_array_equal(got, np.arange(8.0))
+    # abandon session 1 mid-protocol; a fresh session still works
+    pg1.shutdown()
+
+    pg2 = ParameterServer.new_session(ps.address(), timeout=30.0)
+    try:
+        (got2,) = pg2.broadcast([np.zeros(8)], root=0).get_future().wait()
+        np.testing.assert_array_equal(got2, np.arange(8.0))
+        (r,) = pg2.allreduce([np.ones(8)], ReduceOp.SUM).get_future().wait()
+        np.testing.assert_array_equal(r, np.ones(8))
+    finally:
+        pg2.shutdown()

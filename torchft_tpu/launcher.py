@@ -1,0 +1,200 @@
+"""Multi-replica-group job launcher (reference: torchft/torchx.py:17-89).
+
+The reference exposes a TorchX component that materialises one
+``torchrun``-managed role per replica group with the env contract
+``REPLICA_GROUP_ID`` / ``NUM_REPLICA_GROUPS`` / ``TORCHFT_LIGHTHOUSE``.
+This launcher provides the same contract for local/multi-process TPU jobs —
+and additionally *supervises*: failed replica groups are restarted up to
+``--max-restarts`` times, which is the piece torchelastic provided in the
+reference stack (a replica group that dies rejoins the quorum and live-heals
+from a peer).
+
+CLI::
+
+    python -m torchft_tpu.launcher train.py --replica-groups 2 \
+        --workers-per-replica 1 --max-restarts 3 -- --train-arg ...
+
+or programmatic: ``launch_replica_groups(cmd, num_groups, ...)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from torchft_tpu.coordination import LighthouseServer
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ReplicaGroupSpec", "launch_replica_groups", "main"]
+
+LIGHTHOUSE_ENV = "TORCHFT_LIGHTHOUSE"
+REPLICA_GROUP_ID_ENV = "REPLICA_GROUP_ID"
+NUM_REPLICA_GROUPS_ENV = "NUM_REPLICA_GROUPS"
+GROUP_RANK_ENV = "GROUP_RANK"
+GROUP_WORLD_SIZE_ENV = "GROUP_WORLD_SIZE"
+
+
+@dataclass
+class ReplicaGroupSpec:
+    """One replica group's process set (reference role, torchx.py:55-85)."""
+
+    cmd: List[str]
+    replica_group_id: int
+    num_replica_groups: int
+    workers_per_replica: int = 1
+    env: Dict[str, str] = field(default_factory=dict)
+
+    def spawn(self, lighthouse_addr: str) -> List[subprocess.Popen]:
+        procs = []
+        for group_rank in range(self.workers_per_replica):
+            env = {
+                **os.environ,
+                **self.env,
+                LIGHTHOUSE_ENV: lighthouse_addr,
+                REPLICA_GROUP_ID_ENV: str(self.replica_group_id),
+                NUM_REPLICA_GROUPS_ENV: str(self.num_replica_groups),
+                GROUP_RANK_ENV: str(group_rank),
+                GROUP_WORLD_SIZE_ENV: str(self.workers_per_replica),
+            }
+            procs.append(subprocess.Popen(self.cmd, env=env))
+        return procs
+
+
+def launch_replica_groups(
+    cmd: List[str],
+    num_groups: int,
+    workers_per_replica: int = 1,
+    lighthouse_addr: Optional[str] = None,
+    min_replicas: Optional[int] = None,
+    max_restarts: int = 0,
+    poll_interval: float = 1.0,
+) -> int:
+    """Run ``cmd`` as ``num_groups`` replica groups; supervise + restart.
+
+    Returns the exit code: 0 iff every group eventually exited cleanly.
+    Starts an in-process lighthouse when ``lighthouse_addr`` is None.
+    """
+    own_lighthouse = None
+    if lighthouse_addr is None:
+        own_lighthouse = LighthouseServer(
+            bind="0.0.0.0:0",
+            min_replicas=min_replicas if min_replicas is not None else num_groups,
+        )
+        lighthouse_addr = own_lighthouse.address()
+        logger.info("launcher lighthouse at %s", lighthouse_addr)
+
+    specs = [
+        ReplicaGroupSpec(
+            cmd=cmd,
+            replica_group_id=i,
+            num_replica_groups=num_groups,
+            workers_per_replica=workers_per_replica,
+        )
+        for i in range(num_groups)
+    ]
+    groups: List[List[subprocess.Popen]] = [s.spawn(lighthouse_addr) for s in specs]
+    restarts = [0] * num_groups
+    done = [False] * num_groups
+    failed = False
+
+    stop = threading.Event()
+    prev_handlers = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            prev_handlers[sig] = signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # not on the main thread (tests)
+            pass
+
+    try:
+        while not stop.is_set() and not all(done):
+            time.sleep(poll_interval)
+            for i, procs in enumerate(groups):
+                if done[i]:
+                    continue
+                codes = [p.poll() for p in procs]
+                if all(c == 0 for c in codes):
+                    done[i] = True
+                    logger.info("replica group %d finished", i)
+                elif any(c is not None and c != 0 for c in codes):
+                    # kill stragglers of the dead group, then restart or fail
+                    for p in procs:
+                        if p.poll() is None:
+                            p.terminate()
+                    for p in procs:
+                        p.wait(timeout=30)
+                    if restarts[i] < max_restarts:
+                        restarts[i] += 1
+                        logger.warning(
+                            "replica group %d died (codes=%s); restart %d/%d",
+                            i, codes, restarts[i], max_restarts,
+                        )
+                        groups[i] = specs[i].spawn(lighthouse_addr)
+                    else:
+                        logger.error(
+                            "replica group %d died (codes=%s); out of restarts",
+                            i, codes,
+                        )
+                        done[i] = True
+                        failed = True
+    finally:
+        for procs in groups:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+        for procs in groups:
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        if own_lighthouse is not None:
+            own_lighthouse.shutdown()
+        for sig, h in prev_handlers.items():
+            signal.signal(sig, h)
+
+    return 1 if (failed or stop.is_set()) else 0
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    parser = argparse.ArgumentParser(prog="torchft_tpu_launcher", description=__doc__)
+    parser.add_argument("script", help="worker script (run with this python)")
+    parser.add_argument("--replica-groups", type=int, default=2)
+    parser.add_argument("--workers-per-replica", type=int, default=1)
+    parser.add_argument("--lighthouse", default=None,
+                        help="existing lighthouse addr; else start one")
+    parser.add_argument("--min-replicas", type=int, default=None)
+    parser.add_argument("--max-restarts", type=int, default=0)
+
+    # everything after a literal `--` goes verbatim to the worker script
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--" in argv:
+        split = argv.index("--")
+        argv, worker_args = argv[:split], argv[split + 1:]
+    else:
+        worker_args = []
+    ns = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    code = launch_replica_groups(
+        [sys.executable, ns.script, *worker_args],
+        num_groups=ns.replica_groups,
+        workers_per_replica=ns.workers_per_replica,
+        lighthouse_addr=ns.lighthouse,
+        min_replicas=ns.min_replicas,
+        max_restarts=ns.max_restarts,
+    )
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Standalone lighthouse CLI (reference: src/bin/lighthouse.rs:12-24 and the
+structopt flags in src/lighthouse.rs:94-131).
+
+Run one lighthouse per job::
+
+    python -m torchft_tpu.lighthouse --min-replicas 2 --bind 0.0.0.0:29510
+
+Workers point at it via ``TORCHFT_LIGHTHOUSE=http://host:port``. The same
+port serves the HTML dashboard (``/``), ``/status`` JSON, and per-replica
+``POST /replica/{id}/kill``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from torchft_tpu.coordination import LighthouseServer
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="torchft_tpu_lighthouse", description=__doc__
+    )
+    parser.add_argument("--bind", default="0.0.0.0:29510")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--join-timeout-ms", type=int, default=60000)
+    parser.add_argument("--quorum-tick-ms", type=int, default=100)
+    parser.add_argument("--heartbeat-timeout-ms", type=int, default=5000)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    server = LighthouseServer(
+        bind=args.bind,
+        min_replicas=args.min_replicas,
+        join_timeout_ms=args.join_timeout_ms,
+        quorum_tick_ms=args.quorum_tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+    )
+    logging.info("lighthouse listening at %s", server.address())
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,13 @@ from torchft_tpu.coordination import (
     ManagerServer,
 )
 from torchft_tpu.futures import future_timeout
+from torchft_tpu.observability import (
+    log_commit_event,
+    log_error_event,
+    log_quorum_event,
+    trace_span,
+    traced,
+)
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import DummyWork, Future, FutureWork, Work
 
@@ -305,8 +312,10 @@ class Manager:
 
     def wait_quorum(self) -> None:
         assert self._quorum_future is not None, "must call start_quorum first"
-        self._quorum_future.result()
+        with trace_span("torchft::manager::wait_quorum"):
+            self._quorum_future.result()
 
+    @traced("torchft::manager::_async_quorum")
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
@@ -356,14 +365,25 @@ class Manager:
             self._logger.info(
                 f"reconfiguring for quorum_id={quorum.quorum_id} store={store_prefixed_addr}"
             )
+            log_quorum_event(
+                replica_id=self._replica_id,
+                group_rank=self._group_rank,
+                step=self._step,
+                quorum_id=quorum.quorum_id,
+                replica_rank=quorum.replica_rank,
+                replica_world_size=quorum.replica_world_size,
+                heal=quorum.heal,
+                recover_dst_replica_ranks=quorum.recover_dst_replica_ranks,
+            )
             try:
                 self._quorum_id = quorum.quorum_id
-                self._pg.configure(
-                    store_prefixed_addr,
-                    quorum.replica_rank,
-                    quorum.replica_world_size,
-                    quorum_id=quorum.quorum_id,
-                )
+                with trace_span("torchft::manager::_pg::configure"):
+                    self._pg.configure(
+                        store_prefixed_addr,
+                        quorum.replica_rank,
+                        quorum.replica_world_size,
+                        quorum_id=quorum.quorum_id,
+                    )
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in pg configure: {e}")
                 self.report_error(e)
@@ -375,12 +395,13 @@ class Manager:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
-                    self._checkpoint_transport.send_checkpoint(
-                        dst_ranks=quorum.recover_dst_replica_ranks,
-                        step=quorum.max_step,
-                        state_dict=self._manager_state_dict(),
-                        timeout=self._timeout,
-                    )
+                    with trace_span("torchft::manager::send_checkpoint"):
+                        self._checkpoint_transport.send_checkpoint(
+                            dst_ranks=quorum.recover_dst_replica_ranks,
+                            step=quorum.max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                        )
 
                 if quorum.heal:
                     self._healing = True
@@ -395,12 +416,13 @@ class Manager:
                         self._group_rank, timeout=self._timeout
                     )
                     assert quorum.recover_src_replica_rank is not None
-                    self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
-                        src_rank=quorum.recover_src_replica_rank,
-                        metadata=checkpoint_metadata,
-                        step=quorum.max_step,
-                        timeout=self._timeout,
-                    )
+                    with trace_span("torchft::manager::recv_checkpoint"):
+                        self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
+                            src_rank=quorum.recover_src_replica_rank,
+                            metadata=checkpoint_metadata,
+                            step=quorum.max_step,
+                            timeout=self._timeout,
+                        )
                     # restore ft step/batches immediately; user state is
                     # applied from the main thread when safe
                     self.load_state_dict(self._pending_state_dict["torchft"])
@@ -423,6 +445,7 @@ class Manager:
             self._pending_state_dict = None
 
     # ------------------------------------------------------------ allreduce
+    @traced("torchft::manager::allreduce")
     def allreduce(
         self,
         values: Any,
@@ -502,6 +525,13 @@ class Manager:
         """Mark the step as corrupt; it will be discarded at should_commit
         and the PG reconfigured on the next quorum."""
         self._errored = ExceptionWithTraceback(e)
+        log_error_event(
+            replica_id=self._replica_id,
+            group_rank=self._group_rank,
+            step=self._step,
+            quorum_id=self._quorum_id,
+            error=str(e),
+        )
 
     def errored(self) -> Optional[ExceptionWithTraceback]:
         return self._errored
@@ -527,6 +557,7 @@ class Manager:
         return timed.then(callback)
 
     # ------------------------------------------------------------- commit
+    @traced("torchft::manager::should_commit")
     def should_commit(self, timeout: "float | timedelta | None" = None) -> bool:
         """Two-phase commit vote across the replica group; True iff every
         rank of this group is healthy and enough replicas participate
@@ -558,6 +589,16 @@ class Manager:
         )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas} errored={self._errored is not None}"
+        )
+        log_commit_event(
+            replica_id=self._replica_id,
+            group_rank=self._group_rank,
+            step=self._step,
+            quorum_id=self._quorum_id,
+            committed=should_commit,
+            enough_replicas=enough_replicas,
+            errored=self._errored is not None,
+            num_participants=self.num_participants(),
         )
 
         self._checkpoint_transport.disallow_checkpoint()

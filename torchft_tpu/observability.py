@@ -1,0 +1,149 @@
+"""Structured event logging + profiler spans (reference: torchft otel.py:44-99
+and the ``record_function`` spans on manager hot paths, manager.py:410-936).
+
+Three structured event streams mirror the reference's loggers:
+
+- ``torchft_quorums`` — one record per quorum change (quorum id, replicas,
+  participation, heal/recovery roles);
+- ``torchft_commits`` — one record per ``should_commit`` decision;
+- ``torchft_errors`` — one record per reported error / PG abort.
+
+Records are JSON-serialised into the standard ``logging`` stream, and — when
+``TORCHFT_USE_OTEL=1`` and the ``opentelemetry`` packages are importable —
+additionally exported over OTLP with resource attributes taken from
+``TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON``. The OTLP path is optional and
+degrades silently to console-only, matching the reference's opt-in design.
+
+``trace_span(name)`` provides the ``torch.profiler.record_function`` analog:
+a ``jax.profiler.TraceAnnotation`` visible in XLA/perfetto traces, falling
+back to a no-op when profiling is unavailable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+USE_OTEL_ENV = "TORCHFT_USE_OTEL"
+OTEL_RESOURCE_ATTRS_ENV = "TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON"
+
+QUORUM_EVENTS = "torchft_quorums"
+COMMIT_EVENTS = "torchft_commits"
+ERROR_EVENTS = "torchft_errors"
+
+_otel_providers: Dict[str, Any] = {}
+
+
+def _resource_attributes() -> Dict[str, Any]:
+    raw = os.environ.get(OTEL_RESOURCE_ATTRS_ENV)
+    if not raw:
+        return {}
+    try:
+        attrs = json.loads(raw)
+        return attrs if isinstance(attrs, dict) else {}
+    except json.JSONDecodeError:
+        logging.getLogger(__name__).warning(
+            "invalid %s; ignoring", OTEL_RESOURCE_ATTRS_ENV
+        )
+        return {}
+
+
+def _maybe_otel_logger(name: str) -> Optional[Any]:
+    """Build (and cache) an OTLP logger for ``name`` if opted in and the
+    opentelemetry SDK is available; else None."""
+    if os.environ.get(USE_OTEL_ENV, "0") not in ("1", "true", "True"):
+        return None
+    if name in _otel_providers:
+        return _otel_providers[name]
+    try:
+        from opentelemetry._logs import set_logger_provider  # noqa: F401
+        from opentelemetry.exporter.otlp.proto.grpc._log_exporter import (
+            OTLPLogExporter,
+        )
+        from opentelemetry.sdk._logs import LoggerProvider, LoggingHandler
+        from opentelemetry.sdk._logs.export import BatchLogRecordProcessor
+        from opentelemetry.sdk.resources import Resource
+
+        provider = LoggerProvider(
+            resource=Resource.create({"service.name": name, **_resource_attributes()})
+        )
+        provider.add_log_record_processor(BatchLogRecordProcessor(OTLPLogExporter()))
+        handler = LoggingHandler(logger_provider=provider)
+        otel_logger = logging.getLogger(f"{name}.otlp")
+        otel_logger.addHandler(handler)
+        otel_logger.propagate = False
+        _otel_providers[name] = otel_logger
+        return otel_logger
+    except Exception:  # noqa: BLE001 — SDK missing or exporter misconfigured
+        _otel_providers[name] = None
+        return None
+
+
+class EventLogger:
+    """A named structured-event stream."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._logger = logging.getLogger(name)
+
+    def log(self, **fields: Any) -> None:
+        record = {"event_time": time.time(), **fields}
+        line = json.dumps(record, default=str)
+        self._logger.info(line)
+        otel = _maybe_otel_logger(self.name)
+        if otel is not None:
+            otel.info(line)
+
+
+_event_loggers: Dict[str, EventLogger] = {}
+
+
+def get_event_logger(name: str) -> EventLogger:
+    if name not in _event_loggers:
+        _event_loggers[name] = EventLogger(name)
+    return _event_loggers[name]
+
+
+def log_quorum_event(**fields: Any) -> None:
+    get_event_logger(QUORUM_EVENTS).log(**fields)
+
+
+def log_commit_event(**fields: Any) -> None:
+    get_event_logger(COMMIT_EVENTS).log(**fields)
+
+
+def log_error_event(**fields: Any) -> None:
+    get_event_logger(ERROR_EVENTS).log(**fields)
+
+
+def traced(name: str):
+    """Decorator form of ``trace_span`` for whole-method spans."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            with trace_span(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+@contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Named span on the device timeline (``jax.profiler.TraceAnnotation``);
+    no-op if jax/profiling is unavailable. Use exactly like the reference's
+    ``torch.profiler.record_function``."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:  # noqa: BLE001
+        yield
+        return
+    with TraceAnnotation(name):
+        yield

@@ -1,0 +1,121 @@
+"""Prototype fault-tolerant parameter server on reconfigurable process groups.
+
+Role-equivalent of the reference's ParameterServer (parameter_server.py:30-194):
+no lighthouse involved — a lightweight HTTP handshake creates per-client
+*sessions*, each backed by a fresh two-member process group (server rank 0,
+client rank 1) bootstrapped through the server's KV store under a
+session-unique prefix. The HTTP handler thread is hijacked to run the
+server half of the session (reference parameter_server.py:84-108), so each
+live session costs one thread and failures are isolated per-session: a dead
+client only tears down its own PG.
+
+Subclass and implement ``forward()`` with the per-session protocol (e.g.
+broadcast current params, receive gradient pushes)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import urllib.request
+import uuid
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from torchft_tpu.coordination import KvStoreServer
+from torchft_tpu.process_group import ProcessGroup, ProcessGroupHost
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ParameterServer"]
+
+
+class ParameterServer(ABC):
+    """Abstract FT parameter server.
+
+    Usage::
+
+        class MyPS(ParameterServer):
+            def forward(self, rank, pg):     # server: rank == 0
+                pg.broadcast([params], root=0).get_future().wait()
+
+        ps = MyPS(port=0)
+        # on the client:
+        pg = ParameterServer.new_session(ps.address())   # rank 1
+    """
+
+    def __init__(self, port: int = 0, timeout: float = 60.0) -> None:
+        self._timeout = timeout
+        self._store = KvStoreServer("0.0.0.0:0")
+        store_port = self._store.port
+        ps = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, format: str, *args: object) -> None:  # noqa: A002
+                logger.debug("ps http: " + format, *args)
+
+            def do_POST(self) -> None:
+                if self.path != "/new_session":
+                    self.send_error(404)
+                    return
+                session_id = str(uuid.uuid4())
+                host = self.server.server_name  # type: ignore[attr-defined]
+                store_addr = (
+                    f"{socket.gethostname()}:{store_port}/session/{session_id}"
+                )
+                body = json.dumps(
+                    {"session_id": session_id, "store_addr": store_addr}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                self.wfile.flush()
+                del host
+                # Hijack this handler thread for the session's server half
+                # (reference parameter_server.py:84-108).
+                pg = ProcessGroupHost(timeout=ps._timeout)
+                try:
+                    pg.configure(store_addr, 0, 2, quorum_id=0)
+                    ps.forward(0, pg)
+                except Exception:  # noqa: BLE001 — per-session isolation
+                    logger.exception("session %s failed", session_id)
+                finally:
+                    pg.shutdown()
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="ps_http"
+        )
+        self._thread.start()
+
+    def address(self) -> str:
+        return f"http://{socket.gethostname()}:{self._server.server_port}"
+
+    @classmethod
+    def new_session(
+        cls, address: str, timeout: float = 60.0
+    ) -> ProcessGroup:
+        """Client side: open a session against a running server; returns a
+        configured two-member PG where the caller is rank 1
+        (reference parameter_server.py:110-139)."""
+        with urllib.request.urlopen(
+            urllib.request.Request(f"{address}/new_session", method="POST"),
+            timeout=timeout,
+        ) as resp:
+            info = json.loads(resp.read().decode())
+        pg = ProcessGroupHost(timeout=timeout)
+        pg.configure(info["store_addr"], 1, 2, quorum_id=0)
+        return pg
+
+    @abstractmethod
+    def forward(self, rank: int, pg: ProcessGroup) -> None:
+        """Per-session protocol; runs with the session PG configured.
+        ``rank`` is 0 on the server's hijacked handler thread."""
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._store.shutdown()

@@ -449,6 +449,14 @@ class ProcessGroupHost(ProcessGroup):
             gen = self._gen
         if gen is not None:
             gen.abort()
+            from torchft_tpu.observability import log_error_event
+
+            log_error_event(
+                source="process_group",
+                event="abort",
+                replica_rank=self._rank,
+                replica_world_size=self._world,
+            )
 
     def shutdown(self) -> None:
         with self._lock:

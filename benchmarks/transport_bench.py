@@ -1,0 +1,128 @@
+"""Checkpoint-transport benchmarks (reference:
+checkpointing/pg_transport_bench.py and http_transport_bench.py — 12GB state
+dict timed over send_checkpoint/recv_checkpoint).
+
+Times a send/recv of a synthetic state pytree between two endpoints on this
+host, for both transports:
+
+    python benchmarks/transport_bench.py --transport http --size-mb 1024
+    python benchmarks/transport_bench.py --transport pg --size-mb 1024 --inplace
+
+Prints one JSON line per run: {"transport", "size_mb", "seconds", "gb_per_s"}.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def make_state(size_mb: int, chunk_mb: int = 64) -> dict:
+    """A state pytree of ~size_mb in chunk_mb float32 leaves (mimics a
+    sharded param/optimizer tree)."""
+    n_chunks = max(1, size_mb // chunk_mb)
+    per = size_mb * (1 << 20) // n_chunks // 4
+    rng = np.random.RandomState(0)
+    return {
+        f"layer_{i}": rng.randn(per).astype(np.float32) for i in range(n_chunks)
+    }
+
+
+def bench_http(state: dict, num_chunks: int, timeout: float) -> float:
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    send = HTTPTransport(timeout=timeout, num_chunks=num_chunks)
+    recv = HTTPTransport(timeout=timeout, num_chunks=num_chunks)
+    try:
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            sf = ex.submit(
+                send.send_checkpoint,
+                dst_ranks=[1], step=1, state_dict={"user": state}, timeout=timeout,
+            )
+            got = recv.recv_checkpoint(
+                src_rank=0, metadata=send.metadata(), step=1, timeout=timeout
+            )
+            sf.result(timeout=timeout)
+        dt = time.perf_counter() - t0
+        assert set(got["user"]) == set(state)
+        return dt
+    finally:
+        send.shutdown()
+        recv.shutdown()
+
+
+def bench_pg(state: dict, inplace: bool, timeout: float) -> float:
+    from torchft_tpu.checkpointing import PGTransport
+    from torchft_tpu.coordination import KvStoreServer
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    store = KvStoreServer("127.0.0.1:0")
+    pgs = [ProcessGroupHost(timeout=timeout) for _ in range(2)]
+    addr = f"127.0.0.1:{store.port}/bench"
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        list(ex.map(lambda r: pgs[r].configure(addr, r, 2, quorum_id=1), range(2)))
+
+    template = (
+        {"user": {k: np.zeros_like(v) for k, v in state.items()}} if inplace else None
+    )
+    sender = PGTransport(pgs[0], timeout=timeout)
+    receiver = PGTransport(
+        pgs[1], timeout=timeout,
+        state_dict_template=(lambda: template) if inplace else None,
+    )
+    try:
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            sf = ex.submit(
+                sender.send_checkpoint,
+                dst_ranks=[1], step=1, state_dict={"user": state}, timeout=timeout,
+            )
+            got = receiver.recv_checkpoint(
+                src_rank=0, metadata=sender.metadata(), step=1, timeout=timeout
+            )
+            sf.result(timeout=timeout)
+        dt = time.perf_counter() - t0
+        assert set(got["user"]) == set(state)
+        return dt
+    finally:
+        sender.shutdown()
+        receiver.shutdown()
+        for pg in pgs:
+            pg.shutdown()
+        store.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--transport", choices=["http", "pg"], default="http")
+    parser.add_argument("--size-mb", type=int, default=256)
+    parser.add_argument("--num-chunks", type=int, default=8,
+                        help="http parallel chunk fetches")
+    parser.add_argument("--inplace", action="store_true",
+                        help="pg: receive into a preallocated template")
+    parser.add_argument("--timeout", type=float, default=600.0)
+    args = parser.parse_args()
+
+    state = make_state(args.size_mb)
+    if args.transport == "http":
+        dt = bench_http(state, args.num_chunks, args.timeout)
+    else:
+        dt = bench_pg(state, args.inplace, args.timeout)
+    print(json.dumps({
+        "transport": args.transport,
+        "size_mb": args.size_mb,
+        "inplace": bool(args.inplace and args.transport == "pg"),
+        "seconds": round(dt, 3),
+        "gb_per_s": round(args.size_mb / 1024 / dt, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Golden-fixture regression tests for the exact DiLoCo math
+(reference pattern: diloco_regression_test.py — deterministic mock updates,
+per-step parameter histories compared against JSON fixtures in
+tests/test_fixtures/, regenerated with WRITE_FIXTURE=true).
+
+The "model" is a dict of small float vectors; the deterministic inner step
+subtracts lr * grad with grad == 2 everywhere (the reference's MockLinear).
+Histories are recorded after every inner step on a single replica group
+against a real in-process lighthouse + manager, so the fixtures pin the full
+fragment schedule: prepare offsets, outer SGD-with-momentum updates,
+fragment_update_alpha merges, and commit-failure rollback.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import optax
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.local_sgd import DiLoCo
+from torchft_tpu.manager import Manager
+from torchft_tpu.process_group import FakeProcessGroupWrapper, ProcessGroupHost
+
+FIXTURE_DIR = Path(__file__).parent / "test_fixtures"
+WRITE_FIXTURE = os.environ.get("WRITE_FIXTURE", "").lower() in ("1", "true")
+
+STEPS = 12
+INNER_LR = 0.1
+GRAD = 2.0  # the reference MockLinear's constant gradient
+
+
+def handle_fixture(name: str, history: "list[dict[str, list[float]]]") -> None:
+    """Compare (or with WRITE_FIXTURE=true, regenerate) a golden history
+    (reference: diloco_regression_test.py:34-69)."""
+    path = FIXTURE_DIR / f"{name}.json"
+    if WRITE_FIXTURE:
+        FIXTURE_DIR.mkdir(exist_ok=True)
+        path.write_text(json.dumps(history, indent=1))
+        pytest.skip(f"wrote fixture {path}")
+    assert path.exists(), f"missing fixture {path}; regenerate with WRITE_FIXTURE=true"
+    golden = json.loads(path.read_text())
+    assert len(history) == len(golden)
+    for step, (got, want) in enumerate(zip(history, golden)):
+        assert set(got) == set(want), f"step {step}: key mismatch"
+        for key in want:
+            np.testing.assert_allclose(
+                got[key], want[key], rtol=1e-6, atol=1e-7,
+                err_msg=f"step {step} param {key} diverged from fixture",
+            )
+
+
+def run_diloco(
+    lighthouse: LighthouseServer,
+    *,
+    num_fragments: int,
+    fragment_sync_delay: int = 0,
+    fragment_update_alpha: float = 0.0,
+    sync_every: int = 4,
+    fail_allreduce_at_step: "int | None" = None,
+) -> "list[dict[str, list[float]]]":
+    params = {
+        "w0": np.arange(4, dtype=np.float32) / 4.0,
+        "w1": np.ones(3, dtype=np.float32),
+        "w2": np.array([-1.0, 1.0], dtype=np.float32),
+    }
+    state = {"params": params}
+
+    def load_state(sd):
+        state["params"] = {k: np.asarray(v) for k, v in sd["params"].items()}
+
+    pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
+    manager = Manager(
+        pg=pg,
+        load_state_dict=load_state,
+        state_dict=lambda: {"params": dict(state["params"])},
+        min_replica_size=1,
+        use_async_quorum=False,
+        replica_id="diloco_regression",
+        lighthouse_addr=f"127.0.0.1:{lighthouse.port}",
+        timeout=10.0,
+    )
+    try:
+        diloco = DiLoCo(
+            manager,
+            state["params"],
+            outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+            sync_every=sync_every,
+            num_fragments=num_fragments,
+            fragment_sync_delay=fragment_sync_delay,
+            fragment_update_alpha=fragment_update_alpha,
+        )
+        history = []
+        for step in range(STEPS):
+            state["params"] = {
+                k: v - INNER_LR * GRAD for k, v in state["params"].items()
+            }
+            if fail_allreduce_at_step is not None and step == fail_allreduce_at_step:
+                pg.report_future_error(RuntimeError("injected allreduce failure"))
+            state["params"] = diloco.step(state["params"])
+            history.append(
+                {k: np.asarray(v).tolist() for k, v in sorted(state["params"].items())}
+            )
+        return history
+    finally:
+        manager.shutdown(wait=False)
+
+
+@pytest.fixture()
+def lighthouse():
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800,
+    )
+    yield lh
+    lh.shutdown()
+
+
+class TestDiLoCoRegression:
+    def test_single_fragment(self, lighthouse):
+        handle_fixture("diloco_1frag", run_diloco(lighthouse, num_fragments=1))
+
+    def test_two_fragments_streaming(self, lighthouse):
+        handle_fixture(
+            "diloco_2frag", run_diloco(lighthouse, num_fragments=2, sync_every=4)
+        )
+
+    def test_three_fragments_streaming(self, lighthouse):
+        handle_fixture(
+            "diloco_3frag", run_diloco(lighthouse, num_fragments=3, sync_every=6)
+        )
+
+    def test_fragment_sync_delay(self, lighthouse):
+        handle_fixture(
+            "diloco_2frag_delay1",
+            run_diloco(
+                lighthouse, num_fragments=2, sync_every=4, fragment_sync_delay=1
+            ),
+        )
+
+    def test_fragment_update_alpha(self, lighthouse):
+        handle_fixture(
+            "diloco_1frag_alpha05",
+            run_diloco(lighthouse, num_fragments=1, fragment_update_alpha=0.5),
+        )
+
+    def test_commit_failure_rolls_back(self, lighthouse):
+        """An injected allreduce failure at a sync boundary must roll the
+        fragment back to its last global params (reference:
+        diloco_regression_test.py:292-400)."""
+        history = run_diloco(
+            lighthouse, num_fragments=1, sync_every=4, fail_allreduce_at_step=3
+        )
+        handle_fixture("diloco_1frag_failstep3", history)
+
+    def test_failure_history_differs_from_healthy(self, lighthouse):
+        healthy = run_diloco(lighthouse, num_fragments=1, sync_every=4)
+        failed = run_diloco(
+            lighthouse, num_fragments=1, sync_every=4, fail_allreduce_at_step=3
+        )
+        # the failed sync restores globals instead of committing the outer step
+        assert not np.allclose(healthy[3]["w1"], failed[3]["w1"])

@@ -1,0 +1,92 @@
+"""Flight-recorder tests (reference: NCCL FR integration, manager.py:808-817,
+process_group.py:87-106)."""
+
+import json
+
+import numpy as np
+
+import torchft_tpu.flight_recorder as fr_mod
+from torchft_tpu.flight_recorder import FR_BASE_PATH_ENV, FlightRecorder
+from torchft_tpu.process_group import ProcessGroupHost
+
+
+def test_ring_buffer_bounded():
+    fr = FlightRecorder(capacity=16)
+    for i in range(20):
+        fr.record("collective", op="allreduce", i=i)
+    assert len(fr._events) == 16
+    # the oldest surviving record is i == 4 (0..3 evicted)
+    assert fr._events[0]["i"] == 4
+    assert fr._events[-1]["i"] == 19
+
+
+def test_env_capacity_tolerates_garbage(monkeypatch):
+    from torchft_tpu.flight_recorder import FR_CAPACITY_ENV, _env_capacity
+
+    monkeypatch.setenv(FR_CAPACITY_ENV, "not_a_number")
+    assert _env_capacity() == 2048
+    monkeypatch.setenv(FR_CAPACITY_ENV, "-5")
+    assert _env_capacity() == 16
+    monkeypatch.setenv(FR_CAPACITY_ENV, "512")
+    assert _env_capacity() == 512
+
+
+def test_dump_disabled_without_env(monkeypatch):
+    monkeypatch.delenv(FR_BASE_PATH_ENV, raising=False)
+    fr = FlightRecorder(capacity=16)
+    fr.record("x")
+    assert fr.dump() is None
+
+
+def test_dump_per_quorum_path(tmp_path, monkeypatch):
+    monkeypatch.setenv(FR_BASE_PATH_ENV, str(tmp_path / "fr"))
+    fr = FlightRecorder(capacity=16)
+    fr.record("quorum_reconfigure", quorum_id=7, replica="replica_a")
+    fr.record("collective", op="allreduce", rank=0, world=2)
+    path = fr.dump(reason="test", quorum_id=7, tag="replica_a_0")
+    assert path is not None
+    assert path.parent.name == "fr_quorum_7"
+    assert path.name == "replica_a_0"
+    events = [json.loads(line) for line in path.read_text().splitlines()]
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["quorum_reconfigure", "collective", "dump"]
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)
+
+
+def test_two_managers_dump_to_distinct_paths(tmp_path, monkeypatch):
+    """Dump identity comes from the caller, so two replicas sharing the
+    process-wide recorder never clobber each other's postmortems."""
+    monkeypatch.setenv(FR_BASE_PATH_ENV, str(tmp_path / "fr"))
+    fr = FlightRecorder(capacity=16)
+    fr.record("manager_error", error="a", replica="rep_a")
+    p_a = fr.dump(reason="manager_error", quorum_id=3, tag="rep_a_0")
+    fr.record("manager_error", error="b", replica="rep_b")
+    p_b = fr.dump(reason="manager_error", quorum_id=3, tag="rep_b_0")
+    assert p_a != p_b
+    assert p_a.exists() and p_b.exists()
+
+
+def test_pg_abort_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv(FR_BASE_PATH_ENV, str(tmp_path / "fr"))
+    fresh = FlightRecorder(capacity=64)
+    monkeypatch.setattr(fr_mod, "recorder", fresh)
+
+    from torchft_tpu.coordination import KvStoreServer
+
+    store = KvStoreServer("127.0.0.1:0")
+    pg = ProcessGroupHost(timeout=5.0)
+    try:
+        pg.configure(f"127.0.0.1:{store.port}/x", 0, 1)
+        pg.allreduce([np.ones(2)]).get_future().wait()
+        pg.abort()
+        path = fresh.dump_path()  # pid-tagged default path
+        assert path is not None and path.exists()
+        events = [json.loads(line) for line in path.read_text().splitlines()]
+        assert any(e["kind"] == "pg_abort" for e in events)
+        assert any(
+            e["kind"] == "collective" and e["op"] == "allreduce" for e in events
+        )
+    finally:
+        pg.shutdown()
+        store.shutdown()

@@ -1,5 +1,7 @@
 """ParameterServer session tests (reference: parameter_server.py usage)."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -41,6 +43,10 @@ def test_session_broadcast_and_push(ps):
         np.testing.assert_array_equal(reduced, push)  # server contributed zeros
     finally:
         pg.shutdown()
+    # the server's handler thread appends just after the collective resolves
+    deadline = time.monotonic() + 10
+    while not ps.grads and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert len(ps.grads) == 1
     np.testing.assert_array_equal(ps.grads[0], np.full(8, 2.0))
 

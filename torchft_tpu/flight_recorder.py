@@ -1,0 +1,100 @@
+"""Flight recorder: in-memory ring buffer of recent FT events, dumped to
+disk on aborts for postmortem debugging.
+
+The reference integrates NCCL's Flight Recorder: per-quorum dump paths
+``{base}_quorum_{id}/{global_rank}`` (manager.py:808-817), recorder state
+reset after reconfigure (manager.py:729-733), and abort-triggered dumps
+through a named pipe (process_group.py:87-106, 879-883). XLA has no
+equivalent runtime recorder, so this module *is* the recorder: hot paths
+append cheap dict records (collective submit/complete, quorum transitions,
+timeouts, aborts) into a bounded deque, and ``dump()`` — called from
+``ProcessGroup.abort()`` and fatal manager errors when
+``TORCHFT_FR_BASE_PATH`` is set — writes the ring as JSON lines.
+
+One recorder is shared per process. Multiple replica-group Managers may run
+in one process (the thread-based test topology), so dump *identity* is the
+caller's: ``dump(reason, quorum_id=..., tag=...)`` takes the dumping
+replica's coordinates rather than reading mutable singleton state, and
+events carry whatever identifying fields the recording site passes.
+
+Thread-safe; recording is O(1) append of already-built dicts, no I/O.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Deque, Dict, Optional
+
+FR_BASE_PATH_ENV = "TORCHFT_FR_BASE_PATH"
+FR_CAPACITY_ENV = "TORCHFT_FR_CAPACITY"
+
+_DEFAULT_CAPACITY = 2048
+
+__all__ = ["FlightRecorder", "recorder"]
+
+
+def _env_capacity() -> int:
+    raw = os.environ.get(FR_CAPACITY_ENV, "")
+    try:
+        cap = int(raw)
+        return max(16, cap)
+    except ValueError:
+        # a bad observability knob must never break training
+        return _DEFAULT_CAPACITY
+
+
+class FlightRecorder:
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        cap = capacity if capacity is not None else _env_capacity()
+        self._events: Deque[Dict[str, Any]] = deque(maxlen=cap)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, kind: str, **fields: Any) -> None:
+        with self._lock:
+            self._seq += 1
+            self._events.append(
+                {"seq": self._seq, "time": time.time(), "kind": kind, **fields}
+            )
+
+    def dump_path(
+        self, quorum_id: "int | str | None" = None, tag: Optional[str] = None
+    ) -> Optional[Path]:
+        base = os.environ.get(FR_BASE_PATH_ENV)
+        if not base:
+            return None
+        qid = quorum_id if quorum_id is not None else "unknown"
+        return Path(f"{base}_quorum_{qid}") / (tag or str(os.getpid()))
+
+    def dump(
+        self,
+        reason: str = "abort",
+        quorum_id: "int | str | None" = None,
+        tag: Optional[str] = None,
+    ) -> Optional[Path]:
+        """Write the ring to ``{base}_quorum_{quorum_id}/{tag}``; returns the
+        path or None when disabled. Never raises (dump runs on failure
+        paths)."""
+        try:
+            path = self.dump_path(quorum_id, tag)
+            if path is None:
+                return None
+            self.record("dump", reason=reason)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with self._lock:
+                events = list(self._events)
+            with open(path, "w") as f:
+                for e in events:
+                    f.write(json.dumps(e, default=str) + "\n")
+            return path
+        except Exception:  # noqa: BLE001
+            return None
+
+
+# process-wide singleton, like the reference's per-process FR
+recorder = FlightRecorder()

@@ -384,6 +384,16 @@ class Manager:
                         quorum.replica_world_size,
                         quorum_id=quorum.quorum_id,
                     )
+                # flight-recorder reconfiguration boundary marker
+                # (reference: manager.py:729-733, 808-817)
+                from torchft_tpu.flight_recorder import recorder
+
+                recorder.record(
+                    "quorum_reconfigure",
+                    quorum_id=quorum.quorum_id,
+                    replica=self._replica_id,
+                    group_rank=self._group_rank,
+                )
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in pg configure: {e}")
                 self.report_error(e)
@@ -525,6 +535,20 @@ class Manager:
         """Mark the step as corrupt; it will be discarded at should_commit
         and the PG reconfigured on the next quorum."""
         self._errored = ExceptionWithTraceback(e)
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            "manager_error",
+            error=str(e),
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+        )
+        recorder.dump(
+            reason="manager_error",
+            quorum_id=self._quorum_id,
+            tag=f"{self._replica_id}_{self._group_rank}",
+        )
         log_error_event(
             replica_id=self._replica_id,
             group_rank=self._group_rank,

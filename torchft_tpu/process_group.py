@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import torchft_tpu.flight_recorder as _fr
 from torchft_tpu.coordination import KvClient
 from torchft_tpu.futures import context_timeout
 from torchft_tpu.work import DummyWork, Future, FutureWork, Work
@@ -457,6 +458,10 @@ class ProcessGroupHost(ProcessGroup):
                 replica_rank=self._rank,
                 replica_world_size=self._world,
             )
+            # abort-triggered postmortem dump (reference: abort→FR named-pipe
+            # trigger, process_group.py:875-883)
+            _fr.recorder.record("pg_abort", rank=self._rank, world=self._world)
+            _fr.recorder.dump(reason="pg_abort")
 
     def shutdown(self) -> None:
         with self._lock:
@@ -493,7 +498,10 @@ class ProcessGroupHost(ProcessGroup):
                 except RuntimeError:
                     pass
 
-    def _submit(self, fn: Callable[["_Comm"], Any]) -> Work:
+    def _submit(self, fn: Callable[["_Comm"], Any], name: str = "op") -> Work:
+        _fr.recorder.record(
+            "collective", op=name, rank=self._rank, world=self._world
+        )
         with self._lock:
             gen = self._gen
             if gen is None:
@@ -518,7 +526,7 @@ class ProcessGroupHost(ProcessGroup):
                 for i in range(len(host))
             ]
 
-        return self._submit(_run)
+        return self._submit(_run, "allreduce")
 
     def allgather(self, arrays):
         host = [_to_host(a) for a in arrays]
@@ -531,7 +539,7 @@ class ProcessGroupHost(ProcessGroup):
             )
             return [gathered[r] for r in range(comm.world)]
 
-        return self._submit(_run)
+        return self._submit(_run, "allgather")
 
     def broadcast(self, arrays, root=0):
         host = [_to_host(a) for a in arrays]
@@ -546,7 +554,7 @@ class ProcessGroupHost(ProcessGroup):
                 return host
             return comm.recv_from(root)
 
-        return self._submit(_run)
+        return self._submit(_run, "broadcast")
 
     def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
         host = [[_to_host(a) for a in chunk] for chunk in input_chunks]
@@ -562,7 +570,7 @@ class ProcessGroupHost(ProcessGroup):
                 for i in range(len(host[0]))
             ]
 
-        return self._submit(_run)
+        return self._submit(_run, "reduce_scatter")
 
     def alltoall(self, input_chunks):
         host = [_to_host(a) for a in input_chunks]
@@ -574,7 +582,7 @@ class ProcessGroupHost(ProcessGroup):
             gathered = comm.exchange({r: host[r] for r in range(comm.world)})
             return [gathered[r] for r in range(comm.world)]
 
-        return self._submit(_run)
+        return self._submit(_run, "alltoall")
 
     def send(self, arrays, dst, tag=0):
         host = [_to_host(a) for a in arrays]
@@ -583,7 +591,7 @@ class ProcessGroupHost(ProcessGroup):
             comm.send_to(dst, ("p2p", tag, host))
             return None
 
-        return self._submit(_run)
+        return self._submit(_run, "send")
 
     def recv(self, src, tag=0):
         def _run(comm):
@@ -591,7 +599,7 @@ class ProcessGroupHost(ProcessGroup):
             assert kind == "p2p" and got_tag == tag, (kind, got_tag, tag)
             return host
 
-        return self._submit(_run)
+        return self._submit(_run, "recv")
 
 
 # ---------------------------------------------------------------------------

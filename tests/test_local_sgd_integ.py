@@ -56,7 +56,16 @@ def make_manager(replica_id, lighthouse, state_holder, use_async_quorum=False):
 
 
 class TestLocalSGDInteg:
-    def test_two_replicas_average_params(self, lighthouse):
+    def test_two_replicas_average_params(self):
+        # min_replicas=2: a singleton quorum (possible under scheduler delays
+        # with min_replicas=1 + short join timeout) would make the replicas
+        # average within different quorums and legitimately diverge; this
+        # test asserts determinism, so quorum must require both.
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=5000,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        )
+
         def replica(rid):
             state = {"params": {"w": np.full(2, float(rid), dtype=np.float32)}}
             manager = make_manager(rid, lighthouse, state, use_async_quorum=True)
@@ -72,8 +81,11 @@ class TestLocalSGDInteg:
             finally:
                 manager.shutdown(wait=False)
 
-        results = run_threads([lambda r=r: replica(r) for r in range(2)])
-        np.testing.assert_array_equal(results[0], results[1])
+        try:
+            results = run_threads([lambda r=r: replica(r) for r in range(2)])
+            np.testing.assert_array_equal(results[0], results[1])
+        finally:
+            lighthouse.shutdown()
 
     def test_diloco_two_replicas_converge(self, lighthouse):
         def replica(rid):

@@ -1,0 +1,58 @@
+"""Pipe plumbing for subprocess-isolated process groups.
+
+Role-equivalent of the reference's torchft/multiprocessing.py:16-37
+(`_MonitoredPipe`): a thin wrapper over a multiprocessing Connection that
+adds recv timeouts and passes exceptions shipped over the pipe through to
+the caller. Used by :class:`torchft_tpu.process_group.ProcessGroupBaby` to
+talk to its child process.
+"""
+
+from __future__ import annotations
+
+import threading
+from datetime import timedelta
+from typing import Any, Optional, Union
+
+__all__ = ["_MonitoredPipe"]
+
+
+class _MonitoredPipe:
+    """Connection wrapper with recv timeout + exception passthrough.
+
+    ``conn`` must quack like ``multiprocessing.connection.Connection``
+    (send / recv / poll / close) — the thread-backed dummy context's pipe
+    (multiprocessing_dummy_context._DummyConnection) also qualifies, so Baby
+    process groups can run threaded in tests.
+    """
+
+    def __init__(self, conn: Any) -> None:
+        self._conn = conn
+        self._lock = threading.Lock()
+
+    def send(self, obj: object) -> None:
+        with self._lock:
+            self._conn.send(obj)
+
+    def recv(self, timeout: Union[float, timedelta]) -> object:
+        """Receive one object; raises TimeoutError if nothing arrives in
+        ``timeout`` seconds, re-raises any Exception instance received."""
+        if isinstance(timeout, timedelta):
+            timeout = timeout.total_seconds()
+        if not self._conn.poll(timeout):
+            raise TimeoutError(f"pipe recv timed out after {timeout}s")
+        item = self._conn.recv()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def poll(self, timeout: Optional[float] = None) -> bool:
+        return self._conn.poll(timeout)
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+    def closed(self) -> bool:
+        return getattr(self._conn, "closed", False)

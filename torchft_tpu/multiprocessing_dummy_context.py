@@ -1,0 +1,123 @@
+"""Thread-backed multiprocessing context.
+
+Role-equivalent of the reference's torchft/multiprocessing_dummy_context.py
+(:24-135): exposes the subset of the ``multiprocessing`` context API that
+:class:`torchft_tpu.process_group.ProcessGroupBaby` uses (``Process`` and
+``Pipe``), but backed by threads and in-process queues. Baby process groups
+constructed with this context run their "child" in a thread of the same
+process — no spawn/pickling overhead — which keeps the Baby test matrix fast
+and debuggable while the spawn context exercises true process isolation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+__all__ = ["DummyContext", "dummy_context"]
+
+
+class _DummyConnection:
+    """One end of an in-process duplex pipe (Connection API subset)."""
+
+    def __init__(self, rx: "queue.Queue[Any]", tx: "queue.Queue[Any]") -> None:
+        self._rx = rx
+        self._tx = tx
+        self.closed = False
+
+    def send(self, obj: Any) -> None:
+        if self.closed:
+            raise OSError("handle is closed")
+        self._tx.put(obj)
+
+    def recv(self) -> Any:
+        item = self._rx.get()
+        if item is _CLOSED:
+            self.closed = True
+            raise EOFError("pipe closed")
+        return item
+
+    def poll(self, timeout: Optional[float] = None) -> bool:
+        try:
+            item = self._rx.get(block=timeout is not None and timeout > 0, timeout=timeout)
+        except queue.Empty:
+            return False
+        # Peek semantics: push it back for the recv() that follows.
+        self._rx.queue.appendleft(item)  # type: ignore[attr-defined]
+        return True
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._tx.put(_CLOSED)
+
+
+_CLOSED = object()
+
+
+def _pipe(duplex: bool = True) -> Tuple[_DummyConnection, _DummyConnection]:
+    a2b: "queue.Queue[Any]" = queue.Queue()
+    b2a: "queue.Queue[Any]" = queue.Queue()
+    return _DummyConnection(b2a, a2b), _DummyConnection(a2b, b2a)
+
+
+class _DummyProcess:
+    """threading.Thread dressed up as a multiprocessing.Process."""
+
+    def __init__(
+        self,
+        target: Callable[..., None],
+        args: Tuple[Any, ...] = (),
+        daemon: bool = True,
+        name: Optional[str] = None,
+    ) -> None:
+        self._target = target
+        self._args = args
+        self.daemon = daemon
+        self.exitcode: Optional[int] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=daemon, name=name or "baby_dummy"
+        )
+        self.pid: Optional[int] = None
+
+    def _run(self) -> None:
+        try:
+            self._target(*self._args)
+            self.exitcode = 0
+        except SystemExit as e:  # child-style exit
+            self.exitcode = int(e.code or 0)
+        except BaseException:  # noqa: BLE001
+            self.exitcode = 1
+
+    def start(self) -> None:
+        self._thread.start()
+        self.pid = self._thread.ident
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    # Threads cannot be killed — the Baby PG falls back to closing the pipes,
+    # which unblocks the worker loop. These exist for API compatibility.
+    def terminate(self) -> None:
+        pass
+
+    def kill(self) -> None:
+        pass
+
+
+class DummyContext:
+    """Thread-backed stand-in for ``multiprocessing.get_context("spawn")``."""
+
+    def Process(self, *args: Any, **kwargs: Any) -> _DummyProcess:
+        return _DummyProcess(*args, **kwargs)
+
+    def Pipe(self, duplex: bool = True) -> Tuple[_DummyConnection, _DummyConnection]:
+        return _pipe(duplex)
+
+
+def dummy_context() -> DummyContext:
+    return DummyContext()

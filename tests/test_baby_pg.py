@@ -92,6 +92,30 @@ class TestDummyContext:
         p.join(5.0)
         assert p.exitcode == 1
 
+    def test_crashed_child_eofs_connections(self):
+        """EOF parity with real process death: when the target dies, its
+        Connection args must close so the parent's recv raises EOFError
+        instead of hanging to timeout."""
+        ctx = DummyContext()
+        local, remote = ctx.Pipe()
+
+        def boom(conn):
+            raise RuntimeError("worker died")
+
+        p = ctx.Process(target=boom, args=(remote,))
+        p.start()
+        p.join(5.0)
+        with pytest.raises(EOFError):
+            local.recv()
+
+    def test_poll_none_blocks_until_data(self):
+        ctx = DummyContext()
+        local, remote = ctx.Pipe()
+        t = threading.Timer(0.2, lambda: remote.send("late"))
+        t.start()
+        assert local.poll(None) is True  # blocks, must not return False early
+        assert local.recv() == "late"
+
 
 class TestBabyPGThreaded:
     def test_allreduce(self, store):

@@ -143,17 +143,11 @@ def _rope(x: jax.Array, theta: float, positions: jax.Array) -> jax.Array:
 def _attention(
     q: jax.Array, k: jax.Array, v: jax.Array, cfg: LlamaConfig
 ) -> jax.Array:
-    """Causal GQA attention. q: [B,S,Hq,hd], k/v: [B,S,Hkv,hd]."""
-    B, S, Hq, hd = q.shape
-    groups = Hq // k.shape[2]
-    k = jnp.repeat(k, groups, axis=2)
-    v = jnp.repeat(v, groups, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-    scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    """Default causal GQA attention: Pallas flash kernel on TPU, XLA
+    elsewhere (torchft_tpu/ops/attention.py)."""
+    from torchft_tpu.ops.attention import causal_attention
+
+    return causal_attention(q, k, v, cfg)
 
 
 def llama_forward(

@@ -39,8 +39,12 @@ class _DummyConnection:
         return item
 
     def poll(self, timeout: Optional[float] = None) -> bool:
+        # Connection.poll(None) blocks until data arrives; poll(0) is a probe.
         try:
-            item = self._rx.get(block=timeout is not None and timeout > 0, timeout=timeout)
+            if timeout is None:
+                item = self._rx.get()
+            else:
+                item = self._rx.get(block=timeout > 0, timeout=timeout or None)
         except queue.Empty:
             return False
         # Peek semantics: push it back for the recv() that follows.
@@ -89,6 +93,12 @@ class _DummyProcess:
             self.exitcode = int(e.code or 0)
         except BaseException:  # noqa: BLE001
             self.exitcode = 1
+        finally:
+            # EOF parity with real process death: a spawn child's exit closes
+            # its Connection fds, which the parent's recv sees as EOFError.
+            for a in self._args:
+                if isinstance(a, _DummyConnection):
+                    a.close()
 
     def start(self) -> None:
         self._thread.start()

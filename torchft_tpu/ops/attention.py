@@ -1,0 +1,102 @@
+"""Fused causal attention for the training hot path.
+
+The reference has no attention kernels of its own (it trains via torchtitan,
+whose SDPA/flash comes from PyTorch); in a standalone TPU framework the
+attention kernel is ours to own. On TPU this dispatches to the Pallas
+flash-attention kernel (tiled online-softmax, never materializes the S x S
+score matrix in HBM — the O(S) memory path that makes long sequences and big
+batches fit); elsewhere (CPU tests, virtual-device dryruns) it falls back to
+a plain XLA implementation with identical semantics.
+
+Layout contract matches torchft_tpu.models.llama: q [B, S, Hq, hd],
+k/v [B, S, Hkv, hd] (GQA: Hq a multiple of Hkv), causal, scaled by
+1/sqrt(hd). Output [B, S, Hq, hd].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["causal_attention", "xla_attention", "flash_attention_tpu"]
+
+
+def _repeat_kv(q: jax.Array, k: jax.Array, v: jax.Array):
+    groups = q.shape[2] // k.shape[2]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    return k, v
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: Any) -> jax.Array:
+    """Plain XLA causal GQA attention (materialized scores, f32 softmax)."""
+    hd = q.shape[-1]
+    k, v = _repeat_kv(q, k, v)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    S = q.shape[1]
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention_tpu(
+    q: jax.Array, k: jax.Array, v: jax.Array, cfg: Any
+) -> jax.Array:
+    """Pallas flash attention (TPU only; full custom-vjp fwd+bwd)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
+
+    hd = q.shape[-1]
+    k, v = _repeat_kv(q, k, v)
+    # kernel layout is [B, H, S, hd]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    S = qt.shape[2]
+    # largest MXU-friendly block that divides S (callers guarantee S % 128 == 0)
+    blk = next(b for b in (512, 256, 128) if S % b == 0)
+    block_sizes = BlockSizes(
+        block_q=blk,
+        block_k_major=blk,
+        block_k=blk,
+        block_b=1,
+        block_q_major_dkv=blk,
+        block_k_major_dkv=blk,
+        block_k_dkv=blk,
+        block_q_dkv=blk,
+        block_k_major_dq=blk,
+        block_k_dq=blk,
+        block_q_dq=blk,
+    )
+    out = flash_attention(
+        qt,
+        kt,
+        vt,
+        causal=True,
+        sm_scale=1.0 / math.sqrt(hd),
+        block_sizes=block_sizes,
+    )
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: Any) -> jax.Array:
+    """Backend-dispatching causal attention: Pallas flash on TPU (when the
+    sequence tiles cleanly), XLA fallback elsewhere."""
+    S, hd = q.shape[1], q.shape[-1]
+    if _on_tpu() and S % 128 == 0 and hd in (64, 128, 256):
+        return flash_attention_tpu(q, k, v, cfg)
+    return xla_attention(q, k, v, cfg)

@@ -72,7 +72,8 @@ class TestXlaAttention:
 
 class TestDispatch:
     def test_cpu_falls_back_to_xla(self):
-        assert jax.default_backend() == "cpu"
+        if jax.default_backend() != "cpu":
+            pytest.skip("fallback dispatch is only observable on cpu")
         B, S, H, hd = 1, 128, 2, 64  # flash-eligible shape, but not on CPU
         ks = jax.random.split(jax.random.PRNGKey(3), 3)
         q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)

@@ -15,7 +15,6 @@ k/v [B, S, Hkv, hd] (GQA: Hq a multiple of Hkv), causal, scaled by
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Any
 
@@ -62,7 +61,9 @@ def flash_attention_tpu(
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     S = qt.shape[2]
-    # largest MXU-friendly block that divides S (callers guarantee S % 128 == 0)
+    if S % 128 != 0:
+        raise ValueError(f"flash attention requires seq_len % 128 == 0, got {S}")
+    # largest MXU-friendly block that divides S
     blk = next(b for b in (512, 256, 128) if S % b == 0)
     block_sizes = BlockSizes(
         block_q=blk,
@@ -88,8 +89,9 @@ def flash_attention_tpu(
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-@functools.cache
 def _on_tpu() -> bool:
+    # not cached: the active backend can change in-process (e.g. a virtual
+    # CPU device context during dryruns), and default_backend() is cheap
     return jax.default_backend() == "tpu"
 
 

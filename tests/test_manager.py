@@ -290,3 +290,78 @@ class TestStateDict:
         state = m._manager_state_dict()
         assert set(state["user"].keys()) == {"default", "extra"}
         assert state["torchft"] == {"step": 0, "batches_committed": 0}
+
+
+class TestInitSyncAndConfig:
+    def test_init_sync_forwarded_to_quorum(self):
+        """init_sync=False must reach the quorum RPC (the server uses it to
+        skip forced recovery at step 0; reference manager.py init_sync)."""
+        m = make_manager(quorum=make_quorum(), init_sync=False)
+        m.start_quorum()
+        m.wait_quorum()
+        kwargs = m._test_client._quorum.call_args.kwargs
+        assert kwargs["init_sync"] is False
+
+    def test_configure_error_marks_errored(self):
+        """A pg.configure failure during reconfiguration must surface via
+        errored() and block the commit (reference: configure error path)."""
+        pg = ProcessGroupDummy()
+        pg.configure = MagicMock(side_effect=RuntimeError("store down"))
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.errored() is not None
+        assert not m.should_commit()
+
+    def test_commit_failures_forwarded(self):
+        """commit_failures must be sent with each quorum request so the
+        lighthouse can bump quorum_id after repeated failures."""
+        m = make_manager(quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._test_client._quorum.call_args.kwargs["commit_failures"] == 0
+
+
+class TestWrapFuture:
+    def test_wrap_future_success_passthrough(self):
+        m = make_manager(quorum=make_quorum())
+        fut = Future()
+        wrapped = m.wrap_future(fut, default="dflt")
+        fut.set_result("ok")
+        assert wrapped.wait(5) == "ok"
+        assert m.errored() is None
+
+    def test_wrap_future_error_swallowed_to_default(self):
+        m = make_manager(quorum=make_quorum())
+        fut = Future()
+        wrapped = m.wrap_future(fut, default="dflt")
+        fut.set_exception(RuntimeError("collective died"))
+        assert wrapped.wait(5) == "dflt"
+        assert m.errored() is not None
+
+    def test_wrap_future_timeout_swallowed_to_default(self):
+        m = make_manager(quorum=make_quorum())
+        fut = Future()  # never completed
+        wrapped = m.wrap_future(fut, default="dflt", timeout=0.1)
+        assert wrapped.wait(10) == "dflt"
+        assert m.errored() is not None
+
+
+class TestStateDictLock:
+    def test_disallow_blocks_manager_state_dict(self):
+        """While the state-dict lock is write-held (training mutating params),
+        _manager_state_dict readers must block until allowed again."""
+        import threading
+
+        m = make_manager(quorum=make_quorum())
+        m.disallow_state_dict_read()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(m._manager_state_dict()), daemon=True
+        )
+        t.start()
+        t.join(0.3)
+        assert t.is_alive(), "read must block while disallowed"
+        m.allow_state_dict_read()
+        t.join(5)
+        assert not t.is_alive() and got

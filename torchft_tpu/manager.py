@@ -271,6 +271,21 @@ class Manager:
             self._load_state_dict_fns[key] = load_fn
             self._user_state_dicts[key] = value_fn
 
+    def set_state_dict_fns(
+        self,
+        load_state_dict: Callable[[Any], None],
+        state_dict: Callable[[], Any],
+    ) -> None:
+        """Deprecated alias kept for reference API parity
+        (manager.py set_state_dict_fns); use register_state_dict_fn."""
+        self._logger.warning(
+            "set_state_dict_fns is deprecated, use register_state_dict_fn"
+        )
+        # Register under "default" (the constructor's slot) so a replica using
+        # this legacy setter stays checkpoint-compatible when healing from a
+        # replica that registered via the constructor, and vice versa.
+        self.register_state_dict_fn("default", load_state_dict, state_dict)
+
     def allow_state_dict_read(self) -> None:
         if self._state_dict_lock.w_locked():
             self._state_dict_lock.w_release()

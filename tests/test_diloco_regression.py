@@ -32,11 +32,20 @@ INNER_LR = 0.1
 GRAD = 2.0  # the reference MockLinear's constant gradient
 
 
-def handle_fixture(name: str, history: "list[dict[str, list[float]]]") -> None:
+def handle_fixture(
+    name: str,
+    history: "list[dict[str, list[float]]]",
+    allow_write: bool = True,
+) -> None:
     """Compare (or with WRITE_FIXTURE=true, regenerate) a golden history
-    (reference: diloco_regression_test.py:34-69)."""
+    (reference: diloco_regression_test.py:34-69).
+
+    ``allow_write=False`` marks compare-only call sites (tests asserting an
+    alternate code path reproduces a golden) so regeneration can never pin
+    the alternate path's output as the golden.
+    """
     path = FIXTURE_DIR / f"{name}.json"
-    if WRITE_FIXTURE:
+    if WRITE_FIXTURE and allow_write:
         FIXTURE_DIR.mkdir(exist_ok=True)
         path.write_text(json.dumps(history, indent=1))
         pytest.skip(f"wrote fixture {path}")
@@ -60,6 +69,8 @@ def run_diloco(
     fragment_update_alpha: float = 0.0,
     sync_every: int = 4,
     fail_allreduce_at_step: "int | None" = None,
+    use_bucketization: "bool | None" = None,
+    bucket_cap_mb: "int | None" = None,
 ) -> "list[dict[str, list[float]]]":
     params = {
         "w0": np.arange(4, dtype=np.float32) / 4.0,
@@ -91,6 +102,8 @@ def run_diloco(
             num_fragments=num_fragments,
             fragment_sync_delay=fragment_sync_delay,
             fragment_update_alpha=fragment_update_alpha,
+            use_bucketization=use_bucketization,
+            bucket_cap_mb=bucket_cap_mb,
         )
         history = []
         for step in range(STEPS):
@@ -130,6 +143,25 @@ class TestDiLoCoRegression:
     def test_three_fragments_streaming(self, lighthouse):
         handle_fixture(
             "diloco_3frag", run_diloco(lighthouse, num_fragments=3, sync_every=6)
+        )
+
+    def test_bucketized_matches_unbucketized(self, lighthouse):
+        """Bucketization is a transport-layer packing: the training math must
+        be bit-identical to the per-tensor path (checked against the same
+        golden fixtures). Multi-bucket splitting is unit-tested directly in
+        test_local_sgd.py."""
+        handle_fixture(
+            "diloco_1frag",
+            run_diloco(lighthouse, num_fragments=1, use_bucketization=True),
+            allow_write=False,
+        )
+        handle_fixture(
+            "diloco_2frag",
+            run_diloco(
+                lighthouse, num_fragments=2, sync_every=4,
+                use_bucketization=True, bucket_cap_mb=1,
+            ),
+            allow_write=False,
         )
 
     def test_fragment_sync_delay(self, lighthouse):

@@ -264,3 +264,54 @@ class TestDistributedSampler:
             list(DistributedSampler(9, 0, r, 1, 2, shuffle=False)) for r in range(2)
         ]
         assert len(shards[0]) == len(shards[1]) == 5
+
+
+class TestBuckets:
+    def test_roundtrip_identity(self):
+        from torchft_tpu.local_sgd import _make_buckets, _unpack_buckets
+
+        arrays = [
+            np.arange(5, dtype=np.float32),
+            np.ones((2, 3), dtype=np.float32),
+            np.array([7], dtype=np.float32),
+        ]
+        buckets = _make_buckets(arrays, cap_bytes=1 << 30)
+        assert len(buckets) == 1  # all fit one bucket
+        out = _unpack_buckets(
+            [flat for flat, _ in buckets], [m for _, m in buckets], len(arrays)
+        )
+        for a, b in zip(arrays, out):
+            np.testing.assert_array_equal(a, b)
+            assert a.dtype == b.dtype
+
+    def test_cap_splits_buckets(self):
+        from torchft_tpu.local_sgd import _make_buckets
+
+        arrays = [np.ones(100, dtype=np.float32) for _ in range(4)]
+        buckets = _make_buckets(arrays, cap_bytes=100 * 4 * 2)  # 2 arrays/bucket
+        assert len(buckets) == 2
+        assert all(flat.size == 200 for flat, _ in buckets)
+
+    def test_dtype_grouping(self):
+        from torchft_tpu.local_sgd import _make_buckets, _unpack_buckets
+
+        arrays = [
+            np.ones(4, dtype=np.float32),
+            np.ones(4, dtype=np.float64),
+            np.full(4, 2.0, dtype=np.float32),
+        ]
+        buckets = _make_buckets(arrays, cap_bytes=1 << 30)
+        assert len(buckets) == 2  # one per dtype
+        out = _unpack_buckets(
+            [flat for flat, _ in buckets], [m for _, m in buckets], len(arrays)
+        )
+        for a, b in zip(arrays, out):
+            np.testing.assert_array_equal(a, b)
+            assert a.dtype == b.dtype
+
+    def test_oversize_array_gets_own_bucket(self):
+        from torchft_tpu.local_sgd import _make_buckets
+
+        arrays = [np.ones(100, dtype=np.float32), np.ones(1000, dtype=np.float32)]
+        buckets = _make_buckets(arrays, cap_bytes=50)  # smaller than any array
+        assert len(buckets) == 2

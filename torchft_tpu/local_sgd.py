@@ -26,6 +26,7 @@ Semantics preserved from the reference:
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -121,6 +122,64 @@ def partition_fragments(leaves: Sequence[Any], num_fragments: int) -> List[List[
     return [f for f in frags if f]
 
 
+# 1 GiB default bucket cap (reference: local_sgd.py:176)
+DEFAULT_BUCKET_CAP_BYTES = 1 << 30
+
+
+def _make_buckets(
+    arrays: List[np.ndarray], cap_bytes: int
+) -> List[tuple]:
+    """Pack arrays into flat same-dtype buckets of at most ``cap_bytes``.
+
+    Returns ``[(flat_buffer, metas), ...]`` with ``metas = [(arr_index,
+    offset, size, shape), ...]``. Fewer, larger collectives amortize the
+    per-op framing/pickling overhead of the host DCN plane — the same
+    motivation as the reference's bucketized allreduce (local_sgd.py:498-566),
+    minus the NCCL-launch angle which does not exist on TPU.
+    """
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, a in enumerate(arrays):
+        by_dtype.setdefault(a.dtype, []).append(i)
+    # group indices first, pack after: no mutable-closure ordering traps
+    groups: List[List[int]] = []
+    for idxs in by_dtype.values():
+        cur: List[int] = []
+        cur_bytes = 0
+        for i in idxs:
+            nbytes = arrays[i].nbytes
+            if cur and cur_bytes + nbytes > cap_bytes:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            groups.append(cur)
+    return [_pack_bucket(arrays, g) for g in groups]
+
+
+def _pack_bucket(arrays: List[np.ndarray], idxs: List[int]) -> tuple:
+    metas = []
+    offset = 0
+    for i in idxs:
+        a = arrays[i]
+        metas.append((i, offset, a.size, a.shape))
+        offset += a.size
+    flat = np.empty(offset, dtype=arrays[idxs[0]].dtype)
+    for (i, off, size, _shape) in metas:
+        flat[off : off + size] = arrays[i].reshape(-1)
+    return flat, metas
+
+
+def _unpack_buckets(buckets_out: List[np.ndarray], bucket_metas: List[List[tuple]], n: int) -> List[np.ndarray]:
+    out: List[Optional[np.ndarray]] = [None] * n
+    for flat, metas in zip(buckets_out, bucket_metas):
+        flat = np.asarray(flat)
+        for (i, off, size, shape) in metas:
+            out[i] = flat[off : off + size].reshape(shape)
+    assert all(o is not None for o in out)
+    return out  # type: ignore[return-value]
+
+
 class _Fragment:
     """One fragment's state: global (backup) params + outer optimizer state +
     in-flight allreduce (reference _StreamingDiLoCoFragment)."""
@@ -134,6 +193,8 @@ class _Fragment:
         outer_tx: "optax.GradientTransformation",
         fragment_update_alpha: float,
         should_quantize: bool,
+        use_bucketization: bool = False,
+        bucket_cap_bytes: int = DEFAULT_BUCKET_CAP_BYTES,
     ) -> None:
         import optax  # noqa: F401  (typing only)
 
@@ -143,6 +204,9 @@ class _Fragment:
         self._outer_tx = outer_tx
         self._alpha = fragment_update_alpha
         self._should_quantize = should_quantize
+        self._use_bucketization = use_bucketization
+        self._bucket_cap_bytes = bucket_cap_bytes
+        self._bucket_metas: Optional[List[List[tuple]]] = None
 
         # global ("original") parameters live on host, like the reference's
         # CPU backups (local_sgd.py:241-253)
@@ -176,9 +240,26 @@ class _Fragment:
             for k, i in enumerate(self.leaf_indices)
         ]
         assert self._work is None, "fragment already has an allreduce in flight"
-        self._work = self._manager.allreduce(
-            pseudograds, should_quantize=self._should_quantize
-        )
+        # Quantized allreduce already concatenates everything into one flat
+        # wire buffer (collectives.py), so pre-bucketing there would add a
+        # redundant copy AND shift fp8 rowwise-scale boundaries (changing
+        # numerics). Bucketize only the unquantized path.
+        if (
+            self._use_bucketization
+            and not self._should_quantize
+            and len(pseudograds) > 1
+        ):
+            buckets = _make_buckets(pseudograds, self._bucket_cap_bytes)
+            self._bucket_metas = [metas for _flat, metas in buckets]
+            self._work = self._manager.allreduce(
+                [flat for flat, _metas in buckets],
+                should_quantize=self._should_quantize,
+            )
+        else:
+            self._bucket_metas = None
+            self._work = self._manager.allreduce(
+                pseudograds, should_quantize=self._should_quantize
+            )
 
     def perform_sync(self, leaves: List[Any]) -> bool:
         """Wait for the allreduce, vote, outer-step on commit
@@ -189,6 +270,11 @@ class _Fragment:
         assert self._work is not None, "perform_sync before prepare_sync"
         avg_pseudograds = self._work.get_future().wait()
         self._work = None
+        if self._bucket_metas is not None:
+            avg_pseudograds = _unpack_buckets(
+                avg_pseudograds, self._bucket_metas, len(self.leaf_indices)
+            )
+            self._bucket_metas = None
 
         # save local, restore global (rollback point)
         local = [np.array(leaves[i], copy=True) for i in self.leaf_indices]
@@ -242,8 +328,22 @@ class DiLoCo:
         fragment_sync_delay: int = 0,
         fragment_update_alpha: float = 0.0,
         should_quantize: bool = False,
+        use_bucketization: Optional[bool] = None,
+        bucket_cap_mb: Optional[int] = None,
     ) -> None:
         import jax
+
+        # env-var default, matching the reference's TORCHFT_USE_BUCKETIZATION
+        # flag (local_sgd.py:28)
+        if use_bucketization is None:
+            use_bucketization = os.environ.get(
+                "TORCHFT_USE_BUCKETIZATION", "false"
+            ).lower() in ("1", "true", "yes")
+        bucket_cap_bytes = (
+            bucket_cap_mb * 1024 * 1024
+            if bucket_cap_mb is not None
+            else DEFAULT_BUCKET_CAP_BYTES
+        )
 
         if manager._use_async_quorum:
             raise ValueError(
@@ -272,6 +372,8 @@ class DiLoCo:
             _Fragment(
                 manager, i, idxs, leaves, outer_tx,
                 fragment_update_alpha, should_quantize,
+                use_bucketization=use_bucketization,
+                bucket_cap_bytes=bucket_cap_bytes,
             )
             for i, idxs in enumerate(fragment_partition)
         ]

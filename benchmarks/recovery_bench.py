@@ -1,0 +1,132 @@
+"""Recovery wall-clock benchmark (the BASELINE.md north-star metric the
+reference never publishes: time to recover after a replica kill).
+
+Two replica groups train a synthetic model through a real lighthouse +
+managers; at a configured step one replica dies. Measured, in seconds:
+
+- **reconfigure**: survivor's commit-to-commit gap spanning the failure
+  (detect dead peer -> abort -> new quorum -> rebuilt communicator).
+- **rejoin**: wall-clock from the restarted replica constructing its Manager
+  to its first committed step (quorum join + live checkpoint heal + commit).
+
+    python benchmarks/recovery_bench.py [--size-mb 64] [--steps 30] [--kill-at 10]
+
+Prints one JSON line: {"reconfigure_s", "rejoin_s", "steady_step_s", "size_mb"}.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from torchft_tpu.coordination import LighthouseServer  # noqa: E402
+from torchft_tpu.manager import Manager  # noqa: E402
+from torchft_tpu.process_group import ProcessGroupHost  # noqa: E402
+
+
+class _Die(Exception):
+    pass
+
+
+def run(size_mb: int, steps: int, kill_at: int) -> dict:
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=2000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=1000,
+    )
+    n_elem = size_mb * (1 << 20) // 4
+    commit_times: dict = {0: [], 1: []}
+    rejoin_s = [None]
+
+    def replica(rid: int, start_step_barrier: threading.Barrier) -> None:
+        attempts = 0
+        while attempts < 2:
+            attempts += 1
+            state = {"params": {"w": np.zeros(n_elem, dtype=np.float32)}}
+            t_ctor = time.perf_counter()
+            manager = Manager(
+                pg=ProcessGroupHost(timeout=5.0),
+                load_state_dict=lambda sd: state.update(
+                    params={k: np.asarray(v) for k, v in sd["params"].items()}
+                ),
+                state_dict=lambda: {"params": dict(state["params"])},
+                min_replica_size=1,
+                use_async_quorum=True,
+                replica_id=f"recovery_bench_{rid}",
+                lighthouse_addr=f"127.0.0.1:{lh.port}",
+                timeout=5.0,
+                quorum_timeout=10.0,
+            )
+            healed = [False]
+            try:
+                if attempts == 1:
+                    start_step_barrier.wait(timeout=30)
+                while manager.current_step() < steps:
+                    manager.start_quorum()
+                    grad = {"w": np.full(n_elem, 0.01, dtype=np.float32)}
+                    avg = manager.allreduce(grad).get_future().wait(30)
+                    if manager.should_commit():
+                        state["params"]["w"] = state["params"]["w"] - avg["w"]
+                        now = time.perf_counter()
+                        commit_times[rid].append((manager.current_step(), now))
+                        if attempts == 2 and not healed[0]:
+                            rejoin_s[0] = now - t_ctor
+                            healed[0] = True
+                    if (
+                        attempts == 1
+                        and rid == 1
+                        and manager.current_step() >= kill_at
+                    ):
+                        raise _Die()
+                return
+            except _Die:
+                # Crash-faithful teardown: shutdown(wait=False) stops the
+                # heartbeat loop and closes sockets — the same observable
+                # effects as process death (there is no graceful-leave RPC in
+                # the protocol), so the lighthouse still detects the failure
+                # via heartbeat expiry and the survivor's gap includes that
+                # detection latency.
+                manager.shutdown(wait=False)
+                continue
+            finally:
+                if manager.current_step() >= steps:
+                    manager.shutdown(wait=False)
+
+    barrier = threading.Barrier(2)
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        futs = [ex.submit(replica, r, barrier) for r in range(2)]
+        for f in futs:
+            f.result(timeout=300)
+    lh.shutdown()
+
+    # survivor's commit gaps: steady state vs the gap spanning the failure
+    times0 = [t for _s, t in commit_times[0]]
+    gaps = np.diff(times0)
+    assert len(gaps) > 3, "not enough survivor commits"
+    reconfigure = float(np.max(gaps))
+    steady = float(np.median(gaps))
+    return {
+        "reconfigure_s": round(reconfigure, 3),
+        "rejoin_s": round(rejoin_s[0], 3) if rejoin_s[0] else None,
+        "steady_step_s": round(steady, 4),
+        "size_mb": size_mb,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size-mb", type=int, default=64)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--kill-at", type=int, default=10)
+    args = p.parse_args()
+    print(json.dumps(run(args.size_mb, args.steps, args.kill_at)))
+
+
+if __name__ == "__main__":
+    main()

@@ -275,3 +275,38 @@ class TestLighthouseManagerE2E:
                 c.quorum("lonely", 0.5)
         finally:
             lh.shutdown()
+
+
+class TestDashboard:
+    """Lighthouse HTTP dashboard (reference: src/lighthouse.rs routes /,
+    /status, /replica/:id/kill serving HTML + JSON + kill buttons)."""
+
+    def test_html_and_json_status(self):
+        import json
+        import urllib.request
+
+        lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200)
+        try:
+            base = f"http://127.0.0.1:{lh.port}"
+            html = urllib.request.urlopen(base + "/", timeout=5).read().decode()
+            assert "quorum" in html.lower()
+            st = json.loads(urllib.request.urlopen(base + "/status", timeout=5).read())
+            assert {"quorum_id", "participants", "heartbeat_ages_ms"} <= set(st)
+        finally:
+            lh.shutdown()
+
+    def test_kill_unknown_replica_is_client_error(self):
+        import urllib.error
+        import urllib.request
+
+        lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{lh.port}/replica/nonexistent/kill",
+                method="POST", data=b"",
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=5)
+            assert 400 <= e.value.code < 500, e.value.code
+        finally:
+            lh.shutdown()

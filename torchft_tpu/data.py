@@ -122,6 +122,11 @@ class StatefulDataIterator:
             self._epoch += 1
             self._offset = 0
             shard = self._shard()
+            if not shard:
+                raise ValueError(
+                    "sampler shard is empty (num_samples < total shards with "
+                    "drop_last=True); nothing to iterate"
+                )
         idx = shard[self._offset]
         self._offset += 1
         return int(idx)

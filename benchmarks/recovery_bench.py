@@ -4,8 +4,9 @@ reference never publishes: time to recover after a replica kill).
 Two replica groups train a synthetic model through a real lighthouse +
 managers; at a configured step one replica dies. Measured, in seconds:
 
-- **reconfigure**: survivor's commit-to-commit gap spanning the failure
-  (detect dead peer -> abort -> new quorum -> rebuilt communicator).
+- **reconfigure**: kill -> survivor's first committed step with a step
+  number past the kill step (detect dead peer -> abort -> new quorum ->
+  rebuilt communicator -> step).
 - **rejoin**: wall-clock from the restarted replica constructing its Manager
   to its first committed step (quorum join + live checkpoint heal + commit).
 

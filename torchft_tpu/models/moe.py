@@ -1,0 +1,289 @@
+"""Mixture-of-Experts Llama variant with expert parallelism.
+
+Not present in the reference (its model families are a CIFAR CNN, MultiMLP
+and torchtitan Llama; EP is absent per SURVEY.md §2.4) but first-class here:
+the sparse-FFN transformer is the standard way to scale params without
+scaling per-token FLOPs, and TPU meshes make expert parallelism a natural
+axis.
+
+TPU-first design:
+- **Static shapes everywhere.** GShard-style capacity-based dispatch: every
+  expert processes exactly ``capacity`` token slots per step; routing is
+  one-hot einsums (dense, MXU-tileable), never gather/scatter with
+  data-dependent shapes. Overflowing tokens fall through on the residual.
+- **Expert parallelism as a mesh axis.** Expert weights carry ``ep`` in
+  their PartitionSpec (leading E dim); when the dispatched activations
+  [E, C, d] are sharded over ``ep``, XLA inserts the all-to-alls — no manual
+  collective code.
+- **Router in f32** (probabilities and cumsum position math need it),
+  payload matmuls in bf16.
+- Attention/norms/RoPE reuse the dense Llama blocks, including the Pallas
+  flash-attention path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.models.llama import LlamaConfig, _attention, _rmsnorm, _rope
+
+__all__ = [
+    "MoEConfig",
+    "MOE_CONFIGS",
+    "moe_init",
+    "moe_forward",
+    "moe_loss",
+    "moe_param_specs",
+    "moe_ffn",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def capacity(self, tokens: int) -> int:
+        """Slots per expert for a batch of ``tokens`` (static given shapes)."""
+        c = int(self.capacity_factor * tokens * self.top_k / self.num_experts)
+        return max(c, self.top_k)
+
+
+MOE_CONFIGS: Dict[str, MoEConfig] = {
+    "debug": MoEConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=128, max_seq_len=128, dtype=jnp.float32,
+        num_experts=4, top_k=2,
+    ),
+    # ~8x330M sparse params, dense-420M compute class
+    "bench_moe": MoEConfig(
+        vocab_size=32000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        ffn_hidden=2816, max_seq_len=2048, num_experts=8, top_k=2,
+    ),
+}
+
+
+def moe_init(key: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
+    """Parameter pytree: llama layout with the FFN replaced by router +
+    stacked experts ([L, E, ...] so lax.scan still sees one layer body)."""
+    k_emb, k_out, k_layers = jax.random.split(key, 3)
+    d, hd = cfg.dim, cfg.head_dim
+    kvd = cfg.n_kv_heads * hd
+    L, E, H = cfg.n_layers, cfg.num_experts, cfg.ffn_hidden
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    ks = jax.random.split(k_layers, 9)
+    layers = {
+        "attn_norm": jnp.ones((L, d), cfg.dtype),
+        "wq": dense_init(ks[0], (L, d, cfg.n_heads * hd), d),
+        "wk": dense_init(ks[1], (L, d, kvd), d),
+        "wv": dense_init(ks[2], (L, d, kvd), d),
+        "wo": dense_init(ks[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+        "ffn_norm": jnp.ones((L, d), cfg.dtype),
+        # router in f32: small, and its probabilities drive routing decisions
+        "router": (jax.random.normal(ks[4], (L, d, E), jnp.float32) / jnp.sqrt(d)),
+        "w_gate": dense_init(ks[5], (L, E, d, H), d),
+        "w_up": dense_init(ks[6], (L, E, d, H), d),
+        "w_down": dense_init(ks[7], (L, E, H, d), H),
+    }
+    return {
+        "embed": dense_init(k_emb, (cfg.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": dense_init(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+def _route(
+    probs: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """GShard top-k routing with per-expert capacity.
+
+    probs: [T, E] f32. Returns (gates [T,k] f32, idx [T,k] int32,
+    pos [T,k] int32 queue position, within [T,k] bool, aux_loss scalar).
+    Slot 0 has queue priority over slot 1, earlier tokens over later — all
+    dense cumsums/one-hots over [T, E], static shapes, no sorting. The
+    [T, E, C] routing tensors are never materialized (at training shapes
+    they would dwarf the activations); dispatch is scatter/gather in
+    :func:`moe_ffn`.
+    """
+    T, E = probs.shape
+    gates, idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    gates = gates / (jnp.sum(gates, axis=-1, keepdims=True) + 1e-9)
+
+    pos_cols = []
+    within_cols = []
+    counts = jnp.zeros((E,), jnp.int32)
+    for j in range(top_k):  # static, small
+        mask = jax.nn.one_hot(idx[:, j], E, dtype=jnp.int32)  # [T, E]
+        pos = jnp.cumsum(mask, axis=0) - 1 + counts[None, :]
+        counts = counts + jnp.sum(mask, axis=0)
+        pos_tok = jnp.sum(pos * mask, axis=-1)  # [T]
+        pos_cols.append(pos_tok)
+        within_cols.append(pos_tok < capacity)
+    pos = jnp.stack(pos_cols, axis=1)
+    within = jnp.stack(within_cols, axis=1)
+
+    # Switch-style load-balancing loss: E * sum_e f_e * P_e
+    f = jnp.mean(jax.nn.one_hot(idx[:, 0], E), axis=0)  # dispatch fraction
+    p = jnp.mean(probs, axis=0)  # mean router prob
+    aux = E * jnp.sum(f * p)
+    return gates, idx, pos, within, aux
+
+
+def _top_k_dispatch(
+    probs: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense [T, E, C] combine/dispatch tensors built from :func:`_route` —
+    test/reference form only; the model uses the scatter/gather path."""
+    T, E = probs.shape
+    gates, idx, pos, within, aux = _route(probs, top_k, capacity)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    for j in range(top_k):
+        combine = combine + (
+            gates[:, j, None, None]
+            * within[:, j].astype(jnp.float32)[:, None, None]
+            * jax.nn.one_hot(idx[:, j], E)[:, :, None]
+            * jax.nn.one_hot(pos[:, j], capacity)[:, None, :]
+        )
+    dispatch = (combine > 0).astype(jnp.float32)
+    return combine, dispatch, aux
+
+
+def moe_ffn(
+    x: jax.Array,
+    router: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    cfg: MoEConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sparse SwiGLU FFN. x: [B, S, d] -> ([B, S, d], aux_loss).
+
+    Dispatch is a scatter-add into the [E*C, d] expert slot buffer and
+    combine is a gather back — O(T*d) routing memory (a dense [T, E, C]
+    one-hot einsum would be gigabytes at training shapes). The batched
+    [E, C, d] x [E, d, h] expert matmuls stay on the MXU, and the ``ep``
+    sharding of the E dim is where XLA inserts the all-to-alls.
+    """
+    B, S, d = x.shape
+    T = B * S
+    C = cfg.capacity(T)
+    flat = x.reshape(T, d)
+
+    logits = flat.astype(jnp.float32) @ router  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx, pos, within, aux = _route(probs, cfg.top_k, C)
+
+    E = cfg.num_experts
+    # slot id in the flattened [E*C] expert queue; out-of-capacity tokens are
+    # parked on slot 0 with zero weight (mode="drop" would also work, but an
+    # explicit zero weight keeps the gradient story obvious)
+    slots = idx * C + jnp.minimum(pos, C - 1)  # [T, k]
+    keep = within.astype(x.dtype)  # [T, k]
+
+    buf = jnp.zeros((E * C, d), x.dtype)
+    for j in range(cfg.top_k):
+        buf = buf.at[slots[:, j]].add(flat * keep[:, j, None])
+    expert_in = buf.reshape(E, C, d)
+
+    h = jax.nn.silu(jnp.einsum("ecd,edh->ech", expert_in, w_gate)) * jnp.einsum(
+        "ecd,edh->ech", expert_in, w_up
+    )
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down).reshape(E * C, d)
+
+    out = jnp.zeros((T, d), x.dtype)
+    for j in range(cfg.top_k):
+        w = (gates[:, j].astype(x.dtype) * keep[:, j])[:, None]
+        out = out + expert_out[slots[:, j]] * w
+    return out.reshape(B, S, d), aux
+
+
+def moe_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: MoEConfig,
+    attention_fn: Optional[Any] = None,
+    remat: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens int32 [B, S] -> (logits f32 [B, S, V], total aux loss)."""
+    attention = attention_fn or _attention
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    h = params["embed"][tokens]
+
+    def layer(carry, layer_params):
+        h, aux_acc = carry
+        x = _rmsnorm(h, layer_params["attn_norm"], cfg.norm_eps)
+        q = (x @ layer_params["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        k = (x @ layer_params["wk"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        v = (x @ layer_params["wv"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+        attn = attention(q, k, v, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
+        h = h + attn @ layer_params["wo"]
+        x = _rmsnorm(h, layer_params["ffn_norm"], cfg.norm_eps)
+        moe_out, aux = moe_ffn(
+            x,
+            layer_params["router"],
+            layer_params["w_gate"],
+            layer_params["w_up"],
+            layer_params["w_down"],
+            cfg,
+        )
+        return (h + moe_out, aux_acc + aux), None
+
+    body = jax.checkpoint(layer) if remat else layer
+    (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux_total / cfg.n_layers
+
+
+def moe_loss(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    targets: jax.Array,
+    cfg: MoEConfig,
+    attention_fn: Optional[Any] = None,
+) -> jax.Array:
+    """Cross-entropy (logsumexp form) + weighted load-balancing aux loss."""
+    logits, aux = moe_forward(params, tokens, cfg, attention_fn=attention_fn)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt) + cfg.aux_loss_weight * aux
+
+
+def moe_param_specs(cfg: MoEConfig) -> Dict[str, Any]:
+    """PartitionSpecs for the MoE pytree: experts over ``ep``, within-expert
+    dims over fsdp/tp (Megatron column/row), dense blocks as in the HSDP
+    Llama specs."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P("fsdp", "tp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "ffn_norm": P(None, None),
+            "router": P(None, "fsdp", None),
+            "w_gate": P(None, "ep", "fsdp", "tp"),
+            "w_up": P(None, "ep", "fsdp", "tp"),
+            "w_down": P(None, "ep", "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "tp"),
+    }

@@ -40,15 +40,17 @@ __all__ = [
 
 
 def make_hsdp_mesh(
-    devices=None, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1
+    devices=None, dp: int = 1, fsdp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1
 ) -> Mesh:
-    """Build a 4-axis mesh. Axis order is outermost-first: dp rides the
-    slowest links (DCN between replica groups), sp/tp the fastest (ICI)."""
+    """Build a 5-axis mesh. Axis order is outermost-first: dp rides the
+    slowest links (DCN between replica groups), sp/tp the fastest (ICI).
+    ``ep`` shards MoE experts (torchft_tpu/models/moe.py); dense-model specs
+    simply never mention it."""
     devices = devices if devices is not None else jax.devices()
-    n = dp * fsdp * sp * tp
+    n = dp * fsdp * ep * sp * tp
     assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
-    arr = np.asarray(devices[:n]).reshape(dp, fsdp, sp, tp)
-    return Mesh(arr, ("dp", "fsdp", "sp", "tp"))
+    arr = np.asarray(devices[:n]).reshape(dp, fsdp, ep, sp, tp)
+    return Mesh(arr, ("dp", "fsdp", "ep", "sp", "tp"))
 
 
 def llama_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:

@@ -2,7 +2,7 @@
 accelerator. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-On a real TPU chip it times the bf16 adamw train step of a ~420M-param Llama
+On a real TPU chip it times the bf16 adamw train step of a ~349M-param Llama
 (the largest per-chip config that leaves room for optimizer state on a 16GB
 v5e; the Llama-3-8B HSDP target shards this same code over a pod — see
 BASELINE.md). The reference publishes no benchmark numbers (BASELINE.md), so
@@ -10,11 +10,61 @@ vs_baseline is reported against the theoretical-peak-based MFU denominator:
 vs_baseline = achieved/peak model-flops (MFU), where beating the reference
 means any nonzero stable number survives replica churn; recovery wall-clock
 is exercised by examples/train_ddp.py --demo.
+
+`timed_train_step` is the single measurement harness — benchmarks/mfu_sweep.py
+imports it so the sweep and the headline bench can't diverge.
 """
 
 import json
 import sys
 import time
+
+
+def timed_train_step(cfg, batch, seq, steps, remat="dots", lr=3e-4):
+    """Compile and time the bf16 adamw train step; returns (tokens/s, mfu).
+
+    One shared harness for bench.py and the sweep: jit with donated
+    params/opt-state, one warmup step forced to a host scalar (on some remote
+    platforms block_until_ready returns before execution completes — only a
+    value fetch is a true barrier), then a timed loop chained through the
+    donated state.
+    """
+    import jax
+    import optax
+
+    from torchft_tpu.models.llama import llama_init, llama_loss
+    from torchft_tpu.utils import peak_flops_per_chip
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(lr)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(llama_loss)(
+            params, tokens, targets, cfg, remat=remat
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size
+    )
+
+    params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
+    float(loss)  # steps chain through donated params
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_token = 6 * cfg.num_params()  # fwd+bwd dense approximation
+    mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
+    return tokens_per_sec, mfu
 
 
 def main() -> None:
@@ -23,52 +73,17 @@ def main() -> None:
     backend = jax.default_backend()
     on_tpu = backend not in ("cpu",)
 
-    import jax.numpy as jnp
-    import optax
-
     from torchft_tpu.models.llama import CONFIGS
-    from torchft_tpu.models.llama import llama_init, llama_loss
 
     if on_tpu:
-        cfg = CONFIGS["bench_420m"]
+        cfg = CONFIGS["bench_350m"]
         batch, seq, steps = 8, 2048, 10
-        # v5e bf16 peak ~197 TFLOP/s
-        peak_flops = 197e12
     else:
         cfg = CONFIGS["tiny"]
         batch, seq, steps = 4, 256, 3
-        peak_flops = 1e12  # nominal, CPU fallback
 
-    params = llama_init(jax.random.PRNGKey(0), cfg)
-    tx = optax.adamw(3e-4)
-    opt_state = tx.init(params)
-
-    def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(llama_loss)(params, tokens, targets, cfg)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    jstep = jax.jit(step, donate_argnums=(0, 1))
-
-    key = jax.random.PRNGKey(1)
-    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
-
-    # warmup/compile. float() forces full materialization — on some remote
-    # platforms block_until_ready returns before execution completes.
-    params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
-    float(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
-    final_loss = float(loss)  # steps chain through donated params
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * steps / dt
+    tokens_per_sec, mfu = timed_train_step(cfg, batch, seq, steps)
     n_params = cfg.num_params()
-    flops_per_token = 6 * n_params  # fwd+bwd dense approximation
-    mfu = tokens_per_sec * flops_per_token / peak_flops
 
     print(
         json.dumps(

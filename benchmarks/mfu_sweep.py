@@ -1,0 +1,34 @@
+"""Sweep remat policy x batch size for the single-chip Llama bench.
+
+Finds the config that maximizes MFU on the local chip; bench.py's settings
+should track the winner. Uses bench.py's `timed_train_step` so the sweep
+measures exactly the workload the headline bench reports. Run on TPU
+hardware:
+    python benchmarks/mfu_sweep.py
+"""
+
+import itertools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import timed_train_step  # noqa: E402
+from torchft_tpu.models.llama import CONFIGS  # noqa: E402
+
+
+def main():
+    cfg = CONFIGS["bench_350m"]
+    seq = 2048
+    for remat_mode, batch in itertools.product(["full", "dots", "none"], [8, 16, 32]):
+        try:
+            tps, mfu = timed_train_step(cfg, batch, seq, steps=10, remat=remat_mode)
+            print(f"remat={remat_mode:5s} batch={batch:3d}: "
+                  f"{tps:10.1f} tok/s  MFU={mfu:.4f}", flush=True)
+        except Exception as e:
+            print(f"remat={remat_mode:5s} batch={batch:3d}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,10 @@ from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
+
+from torchft_tpu.models.remat import ATTN_OUT_NAME, remat_wrap
 
 __all__ = [
     "LlamaConfig",
@@ -65,9 +68,12 @@ CONFIGS: Dict[str, LlamaConfig] = {
         vocab_size=2048, dim=256, n_layers=4, n_heads=8, n_kv_heads=4,
         ffn_hidden=688, max_seq_len=1024,
     ),
-    # ~410M params: single-v5e-chip bench config
-    "bench_420m": LlamaConfig(
-        vocab_size=32000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+    # ~349M params: single-v5e-chip bench config. head_dim is 128 — the MXU
+    # lane width — so the flash kernel's QK/PV matmuls use the full systolic
+    # array (head_dim 64 halves attention throughput on TPU; measured 2.2x
+    # slower fwd+bwd). Same dim/param count as an n_heads=16, hd=64 layout.
+    "bench_350m": LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
         ffn_hidden=2816, max_seq_len=2048,
     ),
     # Llama-3-8B (reference target config, examples/slurm/runner.py)
@@ -155,7 +161,7 @@ def llama_forward(
     tokens: jax.Array,
     cfg: LlamaConfig,
     attention_fn: Optional[Any] = None,
-    remat: bool = True,
+    remat: Any = "dots",
 ) -> jax.Array:
     """tokens: int32 [B, S] -> logits f32 [B, S, vocab].
 
@@ -163,10 +169,10 @@ def llama_forward(
     (torchft_tpu/parallel/ring_attention.py) without touching the rest of the
     stack.
 
-    ``remat`` checkpoints each layer: the backward pass recomputes
-    activations instead of saving every layer's S x S attention residuals —
-    the standard HBM-for-FLOPs trade that makes long sequences fit
-    (jax.checkpoint over the scanned layer body).
+    ``remat`` selects the rematerialization mode for the scanned layer body —
+    see `torchft_tpu.models.remat.remat_wrap`. Default "dots" saves matmul
+    outputs and recomputes the rest, trading HBM for ~25% fewer backward
+    FLOPs vs full remat; pass "full" for models at the edge of HBM.
     """
     attention = attention_fn or _attention
     B, S = tokens.shape
@@ -180,7 +186,9 @@ def llama_forward(
         v = (x @ layer_params["wv"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         q = _rope(q, cfg.rope_theta, positions)
         k = _rope(k, cfg.rope_theta, positions)
-        attn = attention(q, k, v, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
+        attn = jax.ad_checkpoint.checkpoint_name(
+            attention(q, k, v, cfg), ATTN_OUT_NAME
+        ).reshape(B, S, cfg.n_heads * cfg.head_dim)
         h = h + attn @ layer_params["wo"]
         x = _rmsnorm(h, layer_params["ffn_norm"], cfg.norm_eps)
         gated = jax.nn.silu(x @ layer_params["w_gate"]) * (x @ layer_params["w_up"])
@@ -188,7 +196,7 @@ def llama_forward(
         return h, None
 
     # scan over stacked layers: one compiled body, L iterations
-    body = jax.checkpoint(layer) if remat else layer
+    body = remat_wrap(layer, remat)
     h, _ = jax.lax.scan(body, h, params["layers"])
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = (h @ params["lm_head"]).astype(jnp.float32)
@@ -201,6 +209,7 @@ def llama_loss(
     targets: jax.Array,
     cfg: LlamaConfig,
     attention_fn: Optional[Any] = None,
+    remat: Any = "dots",
 ) -> jax.Array:
     """Mean next-token cross-entropy.
 
@@ -209,7 +218,7 @@ def llama_loss(
     HBM, which at vocab ~2GB per step dominates the loss cost on TPU
     (~6% step-time win on the bench config).
     """
-    logits = llama_forward(params, tokens, cfg, attention_fn=attention_fn)
+    logits = llama_forward(params, tokens, cfg, attention_fn=attention_fn, remat=remat)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(lse - tgt)

@@ -27,7 +27,10 @@ import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
+
+from torchft_tpu.models.remat import ATTN_OUT_NAME, remat_wrap
 
 from torchft_tpu.models.llama import LlamaConfig, _attention, _rmsnorm, _rope
 
@@ -214,9 +217,13 @@ def moe_forward(
     tokens: jax.Array,
     cfg: MoEConfig,
     attention_fn: Optional[Any] = None,
-    remat: bool = True,
+    remat: Any = True,
 ) -> Tuple[jax.Array, jax.Array]:
-    """tokens int32 [B, S] -> (logits f32 [B, S, V], total aux loss)."""
+    """tokens int32 [B, S] -> (logits f32 [B, S, V], total aux loss).
+
+    ``remat`` takes the shared modes ("none"/"dots"/"full" or bool aliases;
+    torchft_tpu.models.remat). Default full remat: MoE layers hold per-expert
+    activations, so the conservative mode is the safe default."""
     attention = attention_fn or _attention
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -230,7 +237,9 @@ def moe_forward(
         v = (x @ layer_params["wv"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         q = _rope(q, cfg.rope_theta, positions)
         k = _rope(k, cfg.rope_theta, positions)
-        attn = attention(q, k, v, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
+        attn = jax.ad_checkpoint.checkpoint_name(
+            attention(q, k, v, cfg), ATTN_OUT_NAME
+        ).reshape(B, S, cfg.n_heads * cfg.head_dim)
         h = h + attn @ layer_params["wo"]
         x = _rmsnorm(h, layer_params["ffn_norm"], cfg.norm_eps)
         moe_out, aux = moe_ffn(
@@ -243,7 +252,7 @@ def moe_forward(
         )
         return (h + moe_out, aux_acc + aux), None
 
-    body = jax.checkpoint(layer) if remat else layer
+    body = remat_wrap(layer, remat)
     (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)), params["layers"])
     h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
     logits = (h @ params["lm_head"]).astype(jnp.float32)
@@ -256,9 +265,12 @@ def moe_loss(
     targets: jax.Array,
     cfg: MoEConfig,
     attention_fn: Optional[Any] = None,
+    remat: Any = True,
 ) -> jax.Array:
     """Cross-entropy (logsumexp form) + weighted load-balancing aux loss."""
-    logits, aux = moe_forward(params, tokens, cfg, attention_fn=attention_fn)
+    logits, aux = moe_forward(
+        params, tokens, cfg, attention_fn=attention_fn, remat=remat
+    )
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(lse - tgt) + cfg.aux_loss_weight * aux

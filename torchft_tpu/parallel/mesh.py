@@ -97,6 +97,7 @@ def make_train_step(
     mesh: Mesh,
     attention_fn: Optional[Callable] = None,
     donate: bool = True,
+    remat: Any = "full",
 ) -> Callable:
     """Build the jitted HSDP train step.
 
@@ -116,8 +117,10 @@ def make_train_step(
     tok_sharding = batch_sharding(mesh)
 
     def step(params, opt_state, tokens, targets):
+        # Default remat="full": the sharded targets (8B/70B, long seq) sit at
+        # the HBM edge; callers with headroom can pass "dots" (models/remat).
         loss, grads = jax.value_and_grad(llama_loss)(
-            params, tokens, targets, cfg, attention_fn=attention_fn
+            params, tokens, targets, cfg, attention_fn=attention_fn, remat=remat
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -43,6 +43,32 @@ def force_virtual_cpu_devices(n: int) -> None:
         pass  # backend already initialised; caller's device check reports it
 
 
+def peak_flops_per_chip() -> float:
+    """Dense bf16 peak FLOP/s of the local chip, by device kind.
+
+    The MFU denominator for benchmarks. Unknown kinds (including the CPU
+    test platform) get a nominal 1e12 so MFU-style numbers stay finite
+    without pretending to be comparable.
+    """
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for key, flops in (
+        ("v5 lite", 197e12),   # v5e
+        ("v5e", 197e12),
+        ("v6 lite", 918e12),   # v6e / Trillium
+        ("v6e", 918e12),
+        ("v5p", 459e12),
+        ("v5", 459e12),        # bare "v5" after lite/p checks: assume v5p
+        ("v4", 275e12),
+        ("v3", 123e12),
+        ("v2", 45e12),
+    ):
+        if key in kind:
+            return flops
+    return 1e12
+
+
 def synchronize(tree: Any) -> Any:
     """Block until every array in ``tree`` has been computed.
 

@@ -18,6 +18,11 @@ from torchft_tpu.models.llama import CONFIGS  # noqa: E402
 
 
 def main():
+    import jax
+
+    if jax.default_backend() == "cpu":
+        sys.exit("mfu_sweep needs a TPU; the bench_350m config would grind "
+                 "for hours on CPU (use bench.py, which falls back to tiny).")
     cfg = CONFIGS["bench_350m"]
     seq = 2048
     for remat_mode, batch in itertools.product(["full", "dots", "none"], [8, 16, 32]):

@@ -53,21 +53,22 @@ def run(size_mb: int, steps: int, kill_at: int) -> dict:
             attempts += 1
             state = {"params": {"w": np.zeros(n_elem, dtype=np.float32)}}
             t_ctor = time.perf_counter()
-            manager = Manager(
-                pg=ProcessGroupHost(timeout=5.0),
-                load_state_dict=lambda sd: state.update(
-                    params={k: np.asarray(v) for k, v in sd["params"].items()}
-                ),
-                state_dict=lambda: {"params": dict(state["params"])},
-                min_replica_size=1,
-                use_async_quorum=True,
-                replica_id=f"recovery_bench_{rid}",
-                lighthouse_addr=f"127.0.0.1:{lh.port}",
-                timeout=5.0,
-                quorum_timeout=10.0,
-            )
+            manager = None
             healed = [False]
             try:
+                manager = Manager(
+                    pg=ProcessGroupHost(timeout=5.0),
+                    load_state_dict=lambda sd: state.update(
+                        params={k: np.asarray(v) for k, v in sd["params"].items()}
+                    ),
+                    state_dict=lambda: {"params": dict(state["params"])},
+                    min_replica_size=1,
+                    use_async_quorum=True,
+                    replica_id=f"recovery_bench_{rid}",
+                    lighthouse_addr=f"127.0.0.1:{lh.port}",
+                    timeout=5.0,
+                    quorum_timeout=10.0,
+                )
                 if attempts == 1:
                     start_step_barrier.wait(timeout=30)
                 while manager.current_step() < steps:
@@ -100,7 +101,9 @@ def run(size_mb: int, steps: int, kill_at: int) -> dict:
                 manager.shutdown(wait=False)
                 continue
             finally:
-                if manager.current_step() >= steps:
+                # manager stays None if the constructor raised — don't let a
+                # NameError here mask the original failure.
+                if manager is not None and manager.current_step() >= steps:
                     manager.shutdown(wait=False)
 
     barrier = threading.Barrier(2)

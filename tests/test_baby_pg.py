@@ -230,3 +230,69 @@ class TestBabyPGSpawn:
         finally:
             for pg in pgs:
                 pg.shutdown()
+
+
+class _AbortRecordingPG:
+    """Stub inner PG recording abort() calls (shared memory under threads)."""
+
+    aborted: list = []
+
+    def __init__(self, timeout=60.0):
+        pass
+
+    def configure(self, store_addr, rank, world, quorum_id=0):
+        pass
+
+    def abort(self):
+        _AbortRecordingPG.aborted.append(True)
+
+    def shutdown(self):
+        pass
+
+
+class _BabyAbortStub(ProcessGroupBabyHost):
+    PG_CLASS = _AbortRecordingPG
+
+
+class TestAdvisorRegressions:
+    """Regression tests for the round-1 advisor findings."""
+
+    def test_submit_after_fail_gen_resolves_promptly(self, store):
+        """A future registered after _fail_gen swapped the table must still
+        fail promptly instead of hanging to its wait timeout (register/fail
+        race, torchft_tpu/process_group.py _submit)."""
+        pgs = make_baby_pgs(store, 2)
+        try:
+            gen = pgs[0]._gen
+            orig_send = gen.req.send
+
+            def dying_send(msg):
+                # Simulate the child dying between future registration and
+                # the send landing: _fail_gen runs first, then the send goes
+                # into the (now-undrained) queue.
+                pgs[0]._fail_gen(gen, RuntimeError("child died mid-send"))
+                orig_send(msg)
+
+            gen.req.send = dying_send
+            t0 = time.perf_counter()
+            work = pgs[0].allreduce([np.ones(4, np.float32)], ReduceOp.SUM)
+            with pytest.raises(RuntimeError, match="child died mid-send"):
+                work.get_future().wait(10.0)
+            assert time.perf_counter() - t0 < 5.0, "future hung to timeout"
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+
+    def test_abort_reaches_inner_pg_under_dummy_context(self, store):
+        """abort() must invoke the child's inner pg.abort() when the child is
+        a thread (kill() is a no-op there)."""
+        _AbortRecordingPG.aborted.clear()
+        pg = _BabyAbortStub(timeout=5.0, ctx=DummyContext())
+        pg.configure(f"127.0.0.1:{store.port}/abort_stub", 0, 1, 1)
+        assert not _AbortRecordingPG.aborted
+        pg.abort()
+        deadline = time.perf_counter() + 5.0
+        while not _AbortRecordingPG.aborted and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert _AbortRecordingPG.aborted, "inner pg.abort() never invoked"
+        pg.shutdown()

@@ -116,6 +116,36 @@ class TestDiLoCoValidation:
                    fragment_update_alpha=1.5)
 
 
+class TestBucketizationPrecedence:
+    """TORCHFT_USE_BUCKETIZATION force-enables bucketization even over an
+    explicit use_bucketization=False (reference precedence, local_sgd.py:
+    225-228; advisor regression)."""
+
+    def _mk(self, **kw):
+        m = MockManager()
+        params = {"w": np.zeros(4, np.float32)}
+        return DiLoCo(m, params, optax.sgd(1.0), sync_every=2, **kw)
+
+    def test_env_forces_on_over_explicit_false(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_USE_BUCKETIZATION", "1")
+        d = self._mk(use_bucketization=False)
+        assert all(f._use_bucketization for f in d._fragments)
+
+    def test_env_absent_respects_explicit(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_USE_BUCKETIZATION", raising=False)
+        assert not any(
+            f._use_bucketization for f in self._mk(use_bucketization=False)._fragments
+        )
+        assert all(
+            f._use_bucketization for f in self._mk(use_bucketization=True)._fragments
+        )
+
+    def test_env_false_never_forces_off(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_USE_BUCKETIZATION", "false")
+        d = self._mk(use_bucketization=True)
+        assert all(f._use_bucketization for f in d._fragments)
+
+
 class TestDiLoCoMath:
     """Analytic regression of the DiLoCo update (reference
     diloco_regression_test.py validates the same quantities from fixtures)."""

@@ -16,6 +16,7 @@ from torchft_tpu.coordination import KvStoreServer
 from torchft_tpu.process_group import (
     ErrorSwallowingProcessGroupWrapper,
     FakeProcessGroupWrapper,
+    ManagedProcessGroup,
     ProcessGroupDummy,
     ProcessGroupHost,
     ReduceOp,
@@ -261,3 +262,33 @@ class TestWrappers:
         out = pg.allreduce([np.array([3.0])]).get_future().wait()
         np.testing.assert_allclose(out[0], [3.0])
         assert pg.error() is not None
+
+
+class TestManagedProcessGroupRank:
+    def test_rank_is_int_before_first_quorum(self):
+        """replica_rank() is None until a quorum assigns one; the PG contract
+        is int (advisor regression: ManagedProcessGroup.rank() returned
+        None)."""
+
+        class _MgrStub:
+            def replica_rank(self):
+                return None
+
+            def num_participants(self):
+                return 0
+
+        pg = ManagedProcessGroup(_MgrStub())
+        r = pg.rank()
+        assert isinstance(r, int) and r == 0
+
+    def test_rank_tracks_manager(self):
+        class _MgrStub:
+            def replica_rank(self):
+                return 3
+
+            def num_participants(self):
+                return 4
+
+        pg = ManagedProcessGroup(_MgrStub())
+        assert pg.rank() == 3
+        assert pg.size() == 4

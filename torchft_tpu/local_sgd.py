@@ -333,12 +333,14 @@ class DiLoCo:
     ) -> None:
         import jax
 
-        # env-var default, matching the reference's TORCHFT_USE_BUCKETIZATION
-        # flag (local_sgd.py:28)
-        if use_bucketization is None:
-            use_bucketization = os.environ.get(
-                "TORCHFT_USE_BUCKETIZATION", "false"
-            ).lower() in ("1", "true", "yes")
+        # TORCHFT_USE_BUCKETIZATION matches the reference's precedence
+        # (local_sgd.py:225-228): the env var force-enables bucketization
+        # even when the constructor passed use_bucketization=False; it never
+        # force-disables.
+        env_bucketization = os.environ.get(
+            "TORCHFT_USE_BUCKETIZATION", "false"
+        ).lower() in ("1", "true", "yes")
+        use_bucketization = env_bucketization or bool(use_bucketization)
         bucket_cap_bytes = (
             bucket_cap_mb * 1024 * 1024
             if bucket_cap_mb is not None

@@ -609,6 +609,13 @@ class ProcessGroupHost(ProcessGroup):
 # ---------------------------------------------------------------------------
 
 
+def _call_quietly(fn: Any) -> None:
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 - best-effort abort path
+        pass
+
+
 def _baby_worker(
     pg_class: type,
     store_addr: str,
@@ -618,6 +625,7 @@ def _baby_worker(
     timeout: float,
     req_conn: Any,
     fut_conn: Any,
+    abort_cell: Optional[list] = None,
 ) -> None:
     """Child-side loop of a Baby process group.
 
@@ -652,6 +660,15 @@ def _baby_worker(
     except Exception as e:  # noqa: BLE001
         _post("init", e, "exception")
         return
+    if abort_cell is not None:
+        # Parent-side abort hook. Only effective with the thread-backed
+        # DummyContext (shared memory): kill() is a no-op for threads and
+        # closing the request pipe only unblocks this recv loop, not an op
+        # wedged inside the inner PG — the hook lets the parent's abort()
+        # reach pg.abort() directly. Under a spawn context this appends to
+        # the child's pickled copy, which the parent never sees (and never
+        # needs: kill() works there).
+        abort_cell.append(pg.abort)
     _post("init", None, "result")
 
     while True:
@@ -704,7 +721,13 @@ class ProcessGroupBaby(ProcessGroup):
     class _Gen:
         """One configure() generation: child process, pipes, outstanding ops."""
 
-        def __init__(self, proc: Any, req: "_MonitoredPipe", fut: "_MonitoredPipe"):
+        def __init__(
+            self,
+            proc: Any,
+            req: "_MonitoredPipe",
+            fut: "_MonitoredPipe",
+            abort_cell: Optional[list] = None,
+        ):
             self.proc = proc
             self.req = req
             self.fut_pipe = fut
@@ -712,6 +735,8 @@ class ProcessGroupBaby(ProcessGroup):
             self.lock = threading.Lock()
             self.error: Optional[Exception] = None
             self.stopped = False
+            # child-side pg.abort hook; populated only under DummyContext
+            self.abort_cell: list = [] if abort_cell is None else abort_cell
 
     def __init__(self, timeout: "float | timedelta" = 60.0, ctx: Any = None) -> None:
         super().__init__()
@@ -737,6 +762,7 @@ class ProcessGroupBaby(ProcessGroup):
             ctx = self._ctx
         req_local, req_remote = ctx.Pipe()
         fut_local, fut_remote = ctx.Pipe()
+        abort_cell: list = []
         proc = ctx.Process(
             target=_baby_worker,
             args=(
@@ -748,6 +774,7 @@ class ProcessGroupBaby(ProcessGroup):
                 self._timeout,
                 req_remote,
                 fut_remote,
+                abort_cell,
             ),
             daemon=True,
             name=f"baby_pg_r{replica_rank}",
@@ -763,7 +790,7 @@ class ProcessGroupBaby(ProcessGroup):
                 remote.close()
 
         gen = ProcessGroupBaby._Gen(
-            proc, _MonitoredPipe(req_local), _MonitoredPipe(fut_local)
+            proc, _MonitoredPipe(req_local), _MonitoredPipe(fut_local), abort_cell
         )
         # Init ack: the child's configure() rendezvouses with its peers, so
         # give it the full op timeout plus slack for process startup. On any
@@ -869,6 +896,17 @@ class ProcessGroupBaby(ProcessGroup):
             gen.proc.kill()
         gen.req.close()
         gen.fut_pipe.close()
+        # Under DummyContext the "child" is a thread: kill() was a no-op and
+        # closing the pipes only unblocks its recv loop, not an op wedged
+        # inside the inner PG. Invoke the child's pg.abort() hook directly —
+        # on a daemon thread, because abort() must return promptly even if
+        # the inner abort itself wedges.
+        for hook in list(gen.abort_cell):
+            threading.Thread(
+                target=lambda h=hook: _call_quietly(h),
+                daemon=True,
+                name="baby_pg_inner_abort",
+            ).start()
         self._fail_gen(gen, gen.error)
         # Parent-side postmortem: the child (and its inner PG's abort-time
         # dump) was just killed, so the dump must happen here (reference:
@@ -934,6 +972,23 @@ class ProcessGroupBaby(ProcessGroup):
             err = RuntimeError(f"baby process group pipe broken: {e}")
             self._fail_gen(gen, err)
             raise err from e
+        # Close the register/fail race: _fail_gen swaps gen.futures under
+        # gen.lock and fails only the swapped-out set, so a future registered
+        # after the swap would never resolve (with the thread-backed
+        # DummyContext the send above lands silently in an un-drained queue
+        # and the caller would hang to its wait timeout). _fail_gen sets
+        # gen.error *before* the swap, so if neither stopped nor error is
+        # visible here, our future was registered in time and is covered.
+        if gen.stopped or gen.error is not None:
+            with gen.lock:
+                orphan = gen.futures.pop(op_id, None)
+            if orphan is not None:
+                try:
+                    orphan.set_exception(
+                        gen.error or RuntimeError("process group stopped")
+                    )
+                except RuntimeError:
+                    pass  # resolved concurrently
         return FutureWork(fut)
 
     # -- collectives ------------------------------------------------------
@@ -1175,7 +1230,10 @@ class ManagedProcessGroup(ProcessGroup):
         return self._manager.num_participants()
 
     def rank(self) -> int:
-        return self._manager.replica_rank()
+        # replica_rank() is Optional (None before the first quorum); the PG
+        # contract is int — report rank 0 until a quorum assigns one.
+        r = self._manager.replica_rank()
+        return 0 if r is None else r
 
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
         raise RuntimeError("ManagedProcessGroup is configured by its Manager")

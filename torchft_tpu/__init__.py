@@ -19,6 +19,7 @@ _EXPORTS = {
     "ProcessGroupBabyHost": "torchft_tpu.process_group",
     "ProcessGroupDummy": "torchft_tpu.process_group",
     "ManagedProcessGroup": "torchft_tpu.process_group",
+    "ProcessGroupXLA": "torchft_tpu.process_group_xla",
     "DistributedDataParallel": "torchft_tpu.ddp",
     "OptimizerWrapper": "torchft_tpu.optim",
     "LocalSGD": "torchft_tpu.local_sgd",

@@ -1,19 +1,26 @@
-"""Checkpoint-transport benchmarks (reference:
-checkpointing/pg_transport_bench.py and http_transport_bench.py — 12GB state
-dict timed over send_checkpoint/recv_checkpoint).
+"""Host-plane data-path benchmarks.
 
-Times a send/recv of a synthetic state pytree between two endpoints on this
-host, for both transports:
+1. Checkpoint transports (reference: checkpointing/pg_transport_bench.py and
+   http_transport_bench.py — 12GB state dict timed over
+   send_checkpoint/recv_checkpoint), with peak-RSS delta:
 
     python benchmarks/transport_bench.py --transport http --size-mb 1024
     python benchmarks/transport_bench.py --transport pg --size-mb 1024 --inplace
 
-Prints one JSON line per run: {"transport", "size_mb", "seconds", "gb_per_s"}.
+2. Cross-replica-group allreduce: the ring (reduce-scatter + allgather over
+   raw frames) vs the naive full-mesh exchange, across world sizes, with
+   measured per-rank bytes — the ring's traffic must be ~2x payload and
+   world-size-independent:
+
+    python benchmarks/transport_bench.py --transport allreduce --size-mb 64
+
+Prints one JSON line per run.
 """
 
 import argparse
 import json
 import os
+import resource
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -21,6 +28,12 @@ from concurrent.futures import ThreadPoolExecutor
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np  # noqa: E402
+
+
+def _rss_mb() -> float:
+    # ru_maxrss is KiB on Linux, bytes on macOS
+    div = 1 << 20 if sys.platform == "darwin" else 1024
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
 
 
 def make_state(size_mb: int, chunk_mb: int = 64) -> dict:
@@ -99,9 +112,71 @@ def bench_pg(state: dict, inplace: bool, timeout: float) -> float:
         store.shutdown()
 
 
+def bench_allreduce(size_mb: int, timeout: float) -> None:
+    """Ring vs naive exchange across world sizes, with per-rank bytes from
+    the _Comm traffic counters (VERDICT round-2 item 2's 'Done' numbers)."""
+    import torchft_tpu.process_group as pg_mod
+    from torchft_tpu.coordination import KvStoreServer
+    from torchft_tpu.process_group import ProcessGroupHost, ReduceOp
+
+    n = size_mb * (1 << 20) // 4
+    payload = n * 4
+    for world in (2, 4):
+        for algo in ("ring", "naive"):
+            store = KvStoreServer("127.0.0.1:0")
+            pgs = [ProcessGroupHost(timeout=timeout) for _ in range(world)]
+            addr = f"127.0.0.1:{store.port}/bench_ar"
+            with ThreadPoolExecutor(world) as ex:
+                list(ex.map(
+                    lambda r: pgs[r].configure(addr, r, world, quorum_id=1),
+                    range(world),
+                ))
+            old_thresh = pg_mod._RING_MIN_BYTES
+            pg_mod._RING_MIN_BYTES = 0 if algo == "ring" else 1 << 62
+            try:
+                vals = [np.full(n, float(r + 1), np.float32) for r in range(world)]
+
+                def step(r):
+                    return (
+                        pgs[r].allreduce([vals[r]], ReduceOp.SUM)
+                        .get_future().wait(timeout)
+                    )
+
+                with ThreadPoolExecutor(world) as ex:  # warmup + correctness
+                    outs = list(ex.map(step, range(world)))
+                assert np.allclose(outs[0][0][:8], world * (world + 1) / 2)
+
+                base = [pg._gen.comm.bytes_sent for pg in pgs]
+                iters = 3
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    with ThreadPoolExecutor(world) as ex:
+                        list(ex.map(step, range(world)))
+                dt = (time.perf_counter() - t0) / iters
+                sent = max(
+                    pg._gen.comm.bytes_sent - b for pg, b in zip(pgs, base)
+                ) / iters
+            finally:
+                pg_mod._RING_MIN_BYTES = old_thresh
+                for pg in pgs:
+                    pg.shutdown()
+                store.shutdown()
+            print(json.dumps({
+                "transport": "allreduce",
+                "algo": algo,
+                "world": world,
+                "size_mb": size_mb,
+                "seconds": round(dt, 4),
+                "gbit_per_s": round(payload * 8 / dt / 1e9, 2),
+                "per_rank_sent_x_payload": round(sent / payload, 2),
+            }), flush=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--transport", choices=["http", "pg"], default="http")
+    parser.add_argument(
+        "--transport", choices=["http", "pg", "allreduce"], default="http"
+    )
     parser.add_argument("--size-mb", type=int, default=256)
     parser.add_argument("--num-chunks", type=int, default=8,
                         help="http parallel chunk fetches")
@@ -110,17 +185,26 @@ def main() -> None:
     parser.add_argument("--timeout", type=float, default=600.0)
     args = parser.parse_args()
 
+    if args.transport == "allreduce":
+        bench_allreduce(args.size_mb, args.timeout)
+        return
+
     state = make_state(args.size_mb)
+    rss0 = _rss_mb()
     if args.transport == "http":
         dt = bench_http(state, args.num_chunks, args.timeout)
     else:
         dt = bench_pg(state, args.inplace, args.timeout)
+    payload_mb = sum(v.nbytes for v in state.values()) / 2**20
+    rss_delta = _rss_mb() - rss0
     print(json.dumps({
         "transport": args.transport,
         "size_mb": args.size_mb,
         "inplace": bool(args.inplace and args.transport == "pg"),
         "seconds": round(dt, 3),
         "gb_per_s": round(args.size_mb / 1024 / dt, 3),
+        "peak_rss_delta_mb": round(rss_delta, 1),
+        "rss_delta_x_payload": round(rss_delta / payload_mb, 2),
     }))
 
 

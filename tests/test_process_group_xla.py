@@ -337,4 +337,12 @@ class TestDistributedMode:
     def test_abort_unblocks_peer(self, store):
         outs = _spawn_dist(store, 2, "abort")
         assert "RANK0 ABORTED OK" in outs[0], outs[0]
-        assert "OP FAILED AS EXPECTED" in outs[1] or "UNEXPECTED" not in outs[1], outs[1]
+        # The wedged peer must not hang: either its op fails with a Python
+        # exception, or the JAX coordination service's fatal-error handler
+        # terminates the process (the launcher-restart recovery path) —
+        # which of the two wins the race is runtime timing.
+        unblocked = (
+            "OP FAILED AS EXPECTED" in outs[1]
+            or "Terminating process" in outs[1]
+        )
+        assert unblocked and "<TIMEOUT>" not in outs[1], outs[1]

@@ -71,17 +71,25 @@ class ReduceOp(enum.Enum):
     PRODUCT = "product"
 
 
+def _accum(op: ReduceOp, dst: np.ndarray, src: np.ndarray) -> None:
+    """In-place elementwise accumulate — the one dispatch table shared by the
+    full-mesh exchange (_reduce_np) and the ring (_ring_allreduce)."""
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        dst += src
+    elif op == ReduceOp.MAX:
+        np.maximum(dst, src, out=dst)
+    elif op == ReduceOp.MIN:
+        np.minimum(dst, src, out=dst)
+    elif op == ReduceOp.PRODUCT:
+        dst *= src
+    else:
+        raise ValueError(f"unsupported reduce op: {op}")
+
+
 def _reduce_np(op: ReduceOp, bufs: List[np.ndarray]) -> np.ndarray:
     out = bufs[0].copy()
     for b in bufs[1:]:
-        if op in (ReduceOp.SUM, ReduceOp.AVG):
-            out += b
-        elif op == ReduceOp.MAX:
-            np.maximum(out, b, out=out)
-        elif op == ReduceOp.MIN:
-            np.minimum(out, b, out=out)
-        elif op == ReduceOp.PRODUCT:
-            out *= b
+        _accum(op, out, b)
     if op == ReduceOp.AVG:
         out = out / len(bufs)
     return out
@@ -289,6 +297,10 @@ class _Comm:
         self.aborted = False
         self._lock = threading.Lock()
         self.peers: Dict[int, socket.socket] = {}
+        # traffic accounting (benchmarks/transport_bench.py asserts the ring
+        # path's world-size-independent per-rank bytes from these)
+        self.bytes_sent = 0
+        self.bytes_recv = 0
 
         # store_addr is "host:port/prefix"; the prefix (set per-quorum and
         # per-group-rank by the Manager, reference manager.py:703-705) plus the
@@ -334,10 +346,45 @@ class _Comm:
                     pass
 
     def send_to(self, peer: int, obj: Any) -> None:
-        _send_msg(self.peers[peer], pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        self.bytes_sent += len(payload) + _HDR.size
+        _send_msg(self.peers[peer], payload)
 
     def recv_from(self, peer: int) -> Any:
-        return pickle.loads(_recv_msg(self.peers[peer]))
+        payload = _recv_msg(self.peers[peer])
+        self.bytes_recv += len(payload) + _HDR.size
+        return pickle.loads(payload)
+
+    def send_raw(self, peer: int, buf: Any) -> None:
+        """Frame a raw buffer (no pickle, no concat copy): length header,
+        then the bytes straight from the caller's memory. Typed ndarrays go
+        through a uint8 view — memoryview can't export extended dtypes like
+        ml_dtypes.bfloat16 (the dominant TPU gradient dtype)."""
+        if isinstance(buf, np.ndarray):
+            buf = buf.view(np.uint8)
+        mv = memoryview(buf).cast("B")
+        sock = self.peers[peer]
+        sock.sendall(_HDR.pack(len(mv)))
+        sock.sendall(mv)
+        self.bytes_sent += len(mv) + _HDR.size
+
+    def recv_raw_into(self, peer: int, out: Any) -> None:
+        """Receive one frame directly into a writable buffer (zero staging
+        copies on the receive side)."""
+        sock = self.peers[peer]
+        (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+        if isinstance(out, np.ndarray):
+            out = out.view(np.uint8)
+        mv = memoryview(out).cast("B")
+        if length != len(mv):
+            raise ValueError(f"frame size {length} != buffer size {len(mv)}")
+        got = 0
+        while got < length:
+            n = sock.recv_into(mv[got:], min(length - got, 1 << 20))
+            if n == 0:
+                raise ConnectionError("peer closed connection")
+            got += n
+        self.bytes_recv += length + _HDR.size
 
     def exchange(self, payloads: Dict[int, Any]) -> Dict[int, Any]:
         """Send payloads[r] to each rank r and receive one object from every
@@ -383,6 +430,97 @@ class _Comm:
                 self._listener.close()
             except OSError:
                 pass
+
+
+# Payloads at or above this take the bandwidth-optimal ring; below it the
+# full-mesh exchange wins on latency (one round-trip vs 2*(world-1)).
+_RING_MIN_BYTES = 64 * 1024
+
+
+def _ring_step(comm: "_Comm", right: int, left: int,
+               send_buf: np.ndarray, recv_buf: np.ndarray) -> None:
+    """One ring hop: stream our segment to the right neighbour while
+    draining the left neighbour's into ``recv_buf``. The writer runs on a
+    side thread because both sides send first — with synchronous sockets
+    and multi-MB segments that would deadlock on full TCP buffers."""
+    err: List[BaseException] = []
+
+    def _writer() -> None:
+        try:
+            comm.send_raw(right, send_buf)
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+
+    t = threading.Thread(target=_writer, daemon=True)
+    t.start()
+    comm.recv_raw_into(left, recv_buf)
+    t.join()
+    if err:
+        raise err[0]
+
+
+def _ring_allreduce(comm: "_Comm", leaves: List[np.ndarray], op: ReduceOp) -> List[np.ndarray]:
+    """Bandwidth-optimal allreduce: ring reduce-scatter + ring allgather.
+
+    Per-rank traffic is 2*(world-1)/world * payload — independent of world
+    size — versus the full-mesh exchange's (world-1) * payload (the
+    round-1 data plane's O(world x bytes) weakness). Segments move as raw
+    frames straight out of the flat working buffer: no pickling, and the
+    same bytes are never serialized twice.
+
+    Leaves are packed per dtype into one flat buffer each (gradients are
+    almost always a single dtype, so this is one ring in practice), split
+    into ``world`` segments, and unpacked at the end. Matches
+    ``_reduce_np``'s semantics: accumulate in the input dtype, AVG divides
+    by world at the end.
+    """
+    world, rank = comm.world, comm.rank
+    right, left = (rank + 1) % world, (rank - 1) % world
+    out: List[Optional[np.ndarray]] = [None] * len(leaves)
+
+    groups: Dict[Any, List[int]] = {}
+    for i, a in enumerate(leaves):
+        groups.setdefault(a.dtype, []).append(i)
+
+    for dtype, idxs in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        flat_len = sum(leaves[i].size for i in idxs)
+        seg_len = max(1, -(-flat_len // world))
+        buf = np.zeros(seg_len * world, dtype)
+        ofs = 0
+        for i in idxs:
+            n = leaves[i].size
+            buf[ofs:ofs + n] = leaves[i].ravel()
+            ofs += n
+        segs = buf.reshape(world, seg_len)
+        recv_buf = np.empty(seg_len, dtype)
+
+        # reduce-scatter: after world-1 hops, this rank holds the fully
+        # reduced segment (rank+1) % world
+        for step in range(world - 1):
+            s_idx = (rank - step) % world
+            r_idx = (rank - step - 1) % world
+            _ring_step(comm, right, left, segs[s_idx], recv_buf)
+            _accum(op, segs[r_idx], recv_buf)
+
+        # allgather: circulate the reduced segments
+        for step in range(world - 1):
+            s_idx = (rank + 1 - step) % world
+            r_idx = (rank - step) % world
+            _ring_step(comm, right, left, segs[s_idx], segs[r_idx])
+
+        if op == ReduceOp.AVG:
+            if np.issubdtype(buf.dtype, np.integer):
+                buf = buf / world  # float result, matching _reduce_np
+            else:
+                buf /= world
+
+        ofs = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = buf[ofs:ofs + n].reshape(leaves[i].shape)
+            ofs += n
+
+    return out  # type: ignore[return-value]
 
 
 class ProcessGroupHost(ProcessGroup):
@@ -521,6 +659,13 @@ class ProcessGroupHost(ProcessGroup):
         def _run(comm):
             if comm.world == 1:
                 return host if op != ReduceOp.AVG else [h.copy() for h in host]
+            # Large ndarray payloads ride the ring (per-rank traffic ~2x
+            # payload, world-size-independent); small or non-ndarray ones
+            # (quantized tuples) use the one-round full-mesh exchange.
+            if all(isinstance(h, np.ndarray) for h in host) and (
+                sum(h.nbytes for h in host) >= _RING_MIN_BYTES
+            ):
+                return _ring_allreduce(comm, host, op)
             payload = {r: host for r in range(comm.world) if r != comm.rank}
             gathered = comm.exchange({**payload, comm.rank: host})
             return [
@@ -1070,6 +1215,13 @@ class ErrorSwallowingProcessGroupWrapper(ProcessGroup):
         self._pg = pg
         self._error: Optional[Exception] = None
 
+    @property
+    def device_native(self) -> bool:
+        # forward the inner PG's data-plane capability so wrapping a
+        # ProcessGroupXLA doesn't silently re-enable host staging in the
+        # Manager (it reads this attribute off the outermost PG)
+        return getattr(self._pg, "device_native", False)
+
     def parent(self) -> ProcessGroup:
         return self._pg
 
@@ -1146,6 +1298,10 @@ class FakeProcessGroupWrapper(ProcessGroup):
         self._pg = pg
         self._next_error: Optional[Exception] = None
         self._next_configure_error: Optional[Exception] = None
+
+    @property
+    def device_native(self) -> bool:
+        return getattr(self._pg, "device_native", False)
 
     def report_future_error(self, e: Exception) -> None:
         self._next_error = e

@@ -102,6 +102,7 @@ class _Mailbox:
         self._cond = threading.Condition()
         self._payload: Optional[List[Any]] = None
         self._set = False
+        self._error: Optional[Exception] = None
 
     def put(self, payload: List[Any]) -> None:
         with self._cond:
@@ -109,11 +110,22 @@ class _Mailbox:
             self._set = True
             self._cond.notify_all()
 
+    def fail(self, err: Exception) -> None:
+        """abort() path: wake a blocked get() with the abort error instead of
+        letting it run out its full timeout."""
+        with self._cond:
+            self._error = self._error or err
+            self._cond.notify_all()
+
     def get(self, timeout: float) -> List[Any]:
         with self._cond:
-            if not self._cond.wait_for(lambda: self._set, timeout):
+            if not self._cond.wait_for(
+                lambda: self._set or self._error is not None, timeout
+            ):
                 raise TimeoutError("p2p recv timed out")
-            return self._payload  # type: ignore[return-value]
+            if self._set:
+                return self._payload  # type: ignore[return-value]
+            raise self._error  # type: ignore[misc]
 
 
 class _OpSlot:
@@ -303,6 +315,56 @@ class ProcessGroupXLA(ProcessGroup):
         self._lock = threading.Lock()
         self._seq: Dict[str, int] = {}
         self._error: Optional[Exception] = None
+        self._dispatch_q: Optional[Any] = None  # distributed-mode op stream
+
+    def _distributed_work(self, fn: Callable[[], Any]) -> Work:
+        """Distributed-mode op: dispatch + materialization on one worker
+        thread per PG (preserving issue order, like a communication stream),
+        each op bounded by the configured timeout with ``abort`` as the
+        watchdog — the analog of the reference's NCCL
+        ``_WorkAcceleratorTimeout`` (process_group.py:714-777). Without
+        this, a peer wedged mid-collective would block the caller
+        unboundedly at first materialization."""
+        import queue as _queue
+
+        with self._lock:
+            if self._dispatch_q is None:
+                q: "_queue.Queue" = _queue.Queue()
+                self._dispatch_q = q
+
+                def pump() -> None:
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        item()
+
+                threading.Thread(
+                    target=pump, daemon=True, name="pgxla_dispatch"
+                ).start()
+            q = self._dispatch_q
+
+        fut: Future = Future()
+        timeout = self._timeout
+
+        def run() -> None:
+            import jax
+
+            from torchft_tpu.futures import context_timeout
+
+            try:
+                with context_timeout(self.abort, timeout):
+                    out = fn()
+                    jax.block_until_ready(out)
+                fut.set_result(out)
+            except Exception as e:  # noqa: BLE001
+                try:
+                    fut.set_exception(e)
+                except RuntimeError:
+                    pass
+
+        q.put(run)
+        return FutureWork(fut)
 
     # ------------------------------------------------------------ lifecycle
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
@@ -322,8 +384,23 @@ class ProcessGroupXLA(ProcessGroup):
         with self._lock:
             old, self._world = self._world, None
             self._seq = {}  # fresh op ordering per generation
-        if old is not None and old.distributed:
-            self._teardown_distributed_world()
+        if old is not None:
+            if old.distributed:
+                self._teardown_distributed_world()
+            else:
+                # Ops pending in the abandoned generation can never complete
+                # (this member is leaving); fail them promptly instead of
+                # letting co-resident replicas wait out their full timeouts
+                # (ProcessGroupHost does the same via old.abort()).
+                err = RuntimeError("process group torn down for reconfiguration")
+                old.error = old.error or err
+                with old.lock:
+                    stale_slots = list(old.slots.values())
+                    stale_mbs = list(old.mailboxes.values())
+                for slot in stale_slots:
+                    slot.fail(old.error)
+                for mb in stale_mbs:
+                    mb.fail(old.error)
 
         if mode == "local":
             world = self._configure_local(store_addr, replica_world_size, quorum_id)
@@ -345,6 +422,10 @@ class ProcessGroupXLA(ProcessGroup):
         key = (store_addr, quorum_id, world_size)
         with _local_worlds_lock:
             world = _local_worlds.get(key)
+            if world is not None and world.error is not None:
+                # a poisoned generation (aborted/torn down) must not be
+                # handed back to a reconfiguring replica — build fresh
+                world = None
             if world is None:
                 leads = _lead_devices_local(world_size)
                 mesh = Mesh(np.array(leads), ("replica",))
@@ -414,13 +495,19 @@ class ProcessGroupXLA(ProcessGroup):
         with self._lock:
             world, self._world = self._world, None
             self._error = self._error or err
+            q, self._dispatch_q = self._dispatch_q, None
+        if q is not None:
+            q.put(None)  # stop the dispatch pump after draining queued ops
         if world is None:
             return
         world.error = world.error or err
         with world.lock:
             slots = list(world.slots.values())
+            mailboxes = list(world.mailboxes.values())
         for slot in slots:
             slot.fail(world.error)
+        for mb in mailboxes:
+            mb.fail(world.error)
         if world.distributed:
             # The XLA analog of ncclCommAbort — except jax.distributed's
             # shutdown is graceful and can block behind a peer wedged in a
@@ -436,7 +523,6 @@ class ProcessGroupXLA(ProcessGroup):
             )
             t.start()
             t.join(5.0)
-            self._teardown_thread = t
 
     def shutdown(self) -> None:
         self.abort()
@@ -533,8 +619,12 @@ class ProcessGroupXLA(ProcessGroup):
         shapes = [tuple(np.shape(a)) for a in arrays]
 
         if world.distributed:
-            outs = self._run_reduce(world, op, {rank: leaves}, shapes)
-            return DummyWork([world.result_for(o, rank) for o in outs])
+            return self._distributed_work(
+                lambda: [
+                    world.result_for(o, rank)
+                    for o in self._run_reduce(world, op, {rank: leaves}, shapes)
+                ]
+            )
 
         def compute(contribs: Dict[int, List[Any]]) -> Dict[int, Any]:
             outs = self._run_reduce(world, op, contribs, shapes)
@@ -563,12 +653,14 @@ class ProcessGroupXLA(ProcessGroup):
             ]
 
         if world.distributed:
-            per_leaf = [
-                world.global_array({rank: leaves[i]}, shapes[i])
-                for i in range(len(shapes))
-            ]
-            outs = world.replicate_fn()(per_leaf)
-            return DummyWork(rows_for(outs, rank))
+            def gather() -> Any:
+                per_leaf = [
+                    world.global_array({rank: leaves[i]}, shapes[i])
+                    for i in range(len(shapes))
+                ]
+                return rows_for(world.replicate_fn()(per_leaf), rank)
+
+            return self._distributed_work(gather)
 
         def compute(contribs: Dict[int, List[Any]]) -> Dict[int, Any]:
             per_leaf = [
@@ -588,8 +680,56 @@ class ProcessGroupXLA(ProcessGroup):
         return FutureWork(fut)
 
     def broadcast(self, arrays: Sequence[Any], root: int = 0) -> Work:
-        work = self.allgather(arrays)
-        fut = work.get_future().then(lambda f: f.value()[root])
+        """Root's arrays land on every rank. Moves only root's payload —
+        1x N bytes to each receiver — not the W x N an allgather would."""
+        world = self._require_world()
+        rank = self._rank
+
+        if world.distributed:
+            shapes = [tuple(np.shape(a)) for a in arrays]
+            leaves = [world.place(rank, a) for a in arrays]
+
+            def bcast() -> Any:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                per_leaf = [
+                    world.global_array({rank: leaves[i]}, shapes[i])
+                    for i in range(len(shapes))
+                ]
+                # a[root] on a replica-sharded array lowers to moving just
+                # root's shard to every device
+                key = ("bcast", root)
+                if key not in world._jit_cache:
+                    world._jit_cache[key] = jax.jit(
+                        lambda args: [a[root] for a in args],
+                        out_shardings=NamedSharding(world.mesh, P()),
+                    )
+                outs = world._jit_cache[key](per_leaf)
+                return [world.result_for(o, rank) for o in outs]
+
+            return self._distributed_work(bcast)
+
+        # local mode: rendezvous (broadcast is still a collective — every
+        # rank joins), then copy root's already-placed leaves out
+        import jax
+
+        payload = (
+            [world.place(rank, a)[0] for a in arrays] if rank == root else []
+        )
+
+        def compute(contribs: Dict[int, List[Any]]) -> Dict[int, Any]:
+            src = contribs[root]
+            return {
+                r: [jax.device_put(a, world.leads[r]) for a in src]
+                for r in contribs
+            }
+
+        seq = self._bump_seq("broadcast")
+        slot = world.slot("broadcast", seq)
+        fut, last = self._deposit_checked(world, slot, "broadcast", seq, rank, payload)
+        if last:
+            self._finish_local(world, slot, "broadcast", seq, compute)
         return FutureWork(fut)
 
     def reduce_scatter(
@@ -610,8 +750,11 @@ class ProcessGroupXLA(ProcessGroup):
             return mine[r * n_per_dest:(r + 1) * n_per_dest]
 
         if world.distributed:
-            outs = self._run_reduce(world, op, {rank: leaves}, shapes)
-            return DummyWork(chunk_of(outs, rank))
+            return self._distributed_work(
+                lambda: chunk_of(
+                    self._run_reduce(world, op, {rank: leaves}, shapes), rank
+                )
+            )
 
         def compute(contribs: Dict[int, List[Any]]) -> Dict[int, Any]:
             outs = self._run_reduce(world, op, contribs, shapes)

@@ -122,7 +122,7 @@ def bench_allreduce(size_mb: int, timeout: float) -> None:
     n = size_mb * (1 << 20) // 4
     payload = n * 4
     for world in (2, 4):
-        for algo in ("ring", "naive"):
+        for algo in ("ring", "naive", "fp8"):
             store = KvStoreServer("127.0.0.1:0")
             pgs = [ProcessGroupHost(timeout=timeout) for _ in range(world)]
             addr = f"127.0.0.1:{store.port}/bench_ar"
@@ -136,11 +136,21 @@ def bench_allreduce(size_mb: int, timeout: float) -> None:
             try:
                 vals = [np.full(n, float(r + 1), np.float32) for r in range(world)]
 
-                def step(r):
-                    return (
-                        pgs[r].allreduce([vals[r]], ReduceOp.SUM)
-                        .get_future().wait(timeout)
-                    )
+                if algo == "fp8":
+                    from torchft_tpu.collectives import allreduce_quantized
+
+                    def step(r):
+                        return (
+                            allreduce_quantized(
+                                [vals[r]], ReduceOp.SUM, pgs[r]
+                            ).get_future().wait(timeout)
+                        )
+                else:
+                    def step(r):
+                        return (
+                            pgs[r].allreduce([vals[r]], ReduceOp.SUM)
+                            .get_future().wait(timeout)
+                        )
 
                 with ThreadPoolExecutor(world) as ex:  # warmup + correctness
                     outs = list(ex.map(step, range(world)))

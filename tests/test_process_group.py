@@ -315,7 +315,13 @@ class TestRingAllreduce:
                 out[0].astype(np.float32), ref, rtol=1e-2
             )
 
-    def test_small_payload_uses_exchange(self, store):
+    def test_small_payload_uses_exchange(self, store, monkeypatch):
+        import torchft_tpu.process_group as pg_mod
+
+        def boom(*a, **k):
+            raise AssertionError("ring must not run for small payloads")
+
+        monkeypatch.setattr(pg_mod, "_ring_allreduce", boom)
         world = 2
         outs, comms = self._run(
             store, world, lambda r: [np.ones(8, np.float32)], ReduceOp.SUM

@@ -180,3 +180,90 @@ class TestQuantizedCollectives:
         )
         np.testing.assert_allclose(out["w"], 3.0, rtol=0.07)
         pgs[0].shutdown()
+
+
+class TestDeviceQuantizedPath:
+    """jax.Array inputs take the Pallas device pipeline (interpret-mode off
+    TPU — same code path, VERDICT round-2 item 5) and return jax.Arrays;
+    numpy inputs keep the host pipeline."""
+
+    WORLD = 2
+
+    def _expected(self, inputs, n_leaves):
+        return [
+            sum(np.asarray(inputs[r][i], dtype=np.float32) for r in range(self.WORLD))
+            for i in range(n_leaves)
+        ]
+
+    def test_device_path_taken_and_matches(self, store, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        import torchft_tpu.collectives as coll
+
+        calls = {"fused_quantize": 0, "fused_dequantize": 0}
+        real_q, real_d = coll.fused_quantize_fp8, coll.fused_dequantize_fp8
+        monkeypatch.setattr(
+            coll, "fused_quantize_fp8",
+            lambda *a, **k: (calls.__setitem__("fused_quantize", calls["fused_quantize"] + 1), real_q(*a, **k))[1],
+        )
+        monkeypatch.setattr(
+            coll, "fused_dequantize_fp8",
+            lambda *a, **k: (calls.__setitem__("fused_dequantize", calls["fused_dequantize"] + 1), real_d(*a, **k))[1],
+        )
+
+        pgs = make_pgs(store, self.WORLD, quorum_id=41)
+        rng = np.random.RandomState(3)
+        host_inputs = [
+            [rng.randn(700).astype(np.float32), rng.randn(40).astype(np.float32)]
+            for _ in range(self.WORLD)
+        ]
+        inputs = [[jnp.asarray(a) for a in leaves] for leaves in host_inputs]
+        expected = self._expected(host_inputs, 2)
+
+        def run(rank):
+            return (
+                allreduce_quantized(inputs[rank], ReduceOp.SUM, pgs[rank])
+                .get_future().wait(timeout=60)
+            )
+
+        with ThreadPoolExecutor(max_workers=self.WORLD) as ex:
+            outs = list(ex.map(run, range(self.WORLD)))
+        assert calls["fused_quantize"] > 0, "Pallas quantize kernel not used"
+        assert calls["fused_dequantize"] > 0, "Pallas dequantize kernel not used"
+        for out in outs:
+            for i in range(2):
+                assert isinstance(out[i], jax.Array), "result left the device"
+                amax = float(np.max(np.abs(expected[i])))
+                np.testing.assert_allclose(
+                    np.asarray(out[i]), expected[i], rtol=0.15, atol=amax / 4
+                )
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_numpy_inputs_keep_host_path(self, store, monkeypatch):
+        import torchft_tpu.collectives as coll
+
+        called = []
+        real_q = coll.fused_quantize_fp8
+        monkeypatch.setattr(
+            coll, "fused_quantize_fp8",
+            lambda *a, **k: (called.append(1), real_q(*a, **k))[1],
+        )
+        pgs = make_pgs(store, self.WORLD, quorum_id=42)
+        inputs = [
+            [np.full(300, float(r + 1), np.float32)] for r in range(self.WORLD)
+        ]
+
+        def run(rank):
+            return (
+                allreduce_quantized(inputs[rank], ReduceOp.SUM, pgs[rank])
+                .get_future().wait(timeout=30)
+            )
+
+        with ThreadPoolExecutor(max_workers=self.WORLD) as ex:
+            outs = list(ex.map(run, range(self.WORLD)))
+        assert not called, "numpy inputs must not take the device kernels"
+        np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(300, 3.0), rtol=0.1)
+        for pg in pgs:
+            pg.shutdown()

@@ -7,6 +7,17 @@ SUM and AVG only. Cuts the replicated-dim wire traffic ~4x vs f32 — on a
 TPU fleet this is DCN bandwidth between replica groups, usually the
 scarcest link.
 
+Two quantization engines behind one wire format (uint8 fp8 payload + f32
+row scales + element count):
+
+- **device (Pallas)**: when every input leaf is a ``jax.Array``, the
+  quantize / dequantize+reduce / requantize stages run as the fused Pallas
+  kernels (ops/quantization.py) on the accelerator — the production path,
+  matching the reference's Triton kernels (torchft/quantization.py:531-686
+  called from collectives.py:297-415). Only the ~1 byte/element compressed
+  payload crosses to the host for the wire, so D2H traffic drops ~4x too.
+- **host (numpy)**: fallback for numpy inputs (and any mixed pytree).
+
 The pipeline runs on a worker thread (reference `_QuantizedOpFuture`,
 collectives.py:139-156) and resolves a Work future with the reduced arrays.
 """
@@ -20,6 +31,8 @@ import numpy as np
 
 from torchft_tpu.ops.quantization import (
     dequantize_fp8_rowwise,
+    fused_dequantize_fp8,
+    fused_quantize_fp8,
     quantize_fp8_rowwise,
 )
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
@@ -28,6 +41,16 @@ from torchft_tpu.work import Future, FutureWork, Work
 __all__ = ["allreduce_quantized", "reduce_scatter_quantized"]
 
 _ROW = 512
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _is_device_tree(arrays: Sequence[Any]) -> bool:
+    import jax
+
+    return bool(arrays) and all(isinstance(a, jax.Array) for a in arrays)
 
 
 def _flatten(arrays: Sequence[Any]) -> tuple[np.ndarray, List[tuple], List[np.dtype]]:
@@ -68,13 +91,106 @@ def _run_async(fn) -> Work:
     return FutureWork(fut)
 
 
+def _flatten_jax(arrays: Sequence[Any]):
+    import jax.numpy as jnp
+
+    shapes = [a.shape for a in arrays]
+    dtypes = [a.dtype for a in arrays]
+    flat = jnp.concatenate([a.astype(jnp.float32).reshape(-1) for a in arrays])
+    return flat, shapes, dtypes
+
+
+def _unflatten_jax(flat, shapes, dtypes) -> List[Any]:
+    out = []
+    off = 0
+    for shape, dtype in zip(shapes, dtypes):
+        size = 1
+        for s in shape:
+            size *= s
+        out.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return out
+
+
+def _wire_from_device(q, scales, n: int):
+    """Device fp8 (rows, row) + scales (rows, 1) -> host wire tuple
+    (uint8 payload, f32 scales, n). The only D2H transfer is the ~1
+    byte/element compressed payload."""
+    return (
+        np.asarray(q).view(np.uint8),
+        np.asarray(scales).reshape(-1),
+        n,
+    )
+
+
+def _device_from_wire(tuples: List[tuple], row: int):
+    """Stack same-shaped wire tuples, dequantize in ONE fused kernel call,
+    return (world, chunk) f32 on device."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ops.quantization import _FP8
+
+    world = len(tuples)
+    qs = np.stack([np.asarray(t[0]).view(_FP8) for t in tuples])  # (w, rows, row)
+    ss = np.stack([np.asarray(t[1]) for t in tuples])  # (w, rows)
+    rows = qs.shape[1]
+    deq = fused_dequantize_fp8(
+        jnp.asarray(qs).reshape(world * rows, row),
+        jnp.asarray(ss).reshape(world * rows, 1),
+        world * rows * row,
+        row,
+    )
+    return deq.reshape(world, rows * row)
+
+
+def _reduce_scatter_core_device(flat, op: ReduceOp, pg: ProcessGroup, row: int):
+    """Device-path pipeline: pad so chunks are whole fp8 rows, quantize the
+    whole buffer in one Pallas call, slice per destination for the wire,
+    then dequantize+reduce the received chunks on device."""
+    import jax.numpy as jnp
+
+    world = pg.size()
+    chunk_rows = max(1, _ceil_div(_ceil_div(int(flat.size), world), row))
+    chunk = chunk_rows * row
+    padded = jnp.zeros((chunk * world,), jnp.float32).at[: flat.size].set(flat)
+    q, scales, _ = fused_quantize_fp8(padded, row)  # (world*chunk_rows, row)
+    sends = [
+        _wire_from_device(
+            q[r * chunk_rows:(r + 1) * chunk_rows],
+            scales[r * chunk_rows:(r + 1) * chunk_rows],
+            chunk,
+        )
+        for r in range(world)
+    ]
+    recvd = pg.alltoall(sends).get_future().wait()
+    deq = _device_from_wire(list(recvd), row)  # (world, chunk) f32 on device
+    acc = deq.sum(axis=0)
+    if op == ReduceOp.AVG:
+        acc = acc / world
+    return acc, chunk, chunk_rows
+
+
+def _allreduce_quantized_device(flat, shapes, dtypes, op, pg, row):
+    import jax.numpy as jnp
+
+    world = pg.size()
+    acc, chunk, chunk_rows = _reduce_scatter_core_device(flat, op, pg, row)
+
+    q, scales, _ = fused_quantize_fp8(acc, row)
+    gathered = pg.allgather([_wire_from_device(q, scales, chunk)]) \
+        .get_future().wait()
+    deq = _device_from_wire([g[0] for g in gathered], row)  # (world, chunk)
+    out = deq.reshape(world * chunk)[: flat.size]
+    return _unflatten_jax(out, shapes, dtypes)
+
+
 def _reduce_scatter_core(
     flat: np.ndarray, op: ReduceOp, pg: ProcessGroup, row: int
 ) -> tuple[np.ndarray, int]:
     """Shared pipeline: pad -> per-dest-chunk quantize -> alltoall -> f32
     accumulate (-> AVG). Returns (this rank's reduced f32 chunk, chunk size)."""
     world = pg.size()
-    chunk = -(-flat.size // world)
+    chunk = _ceil_div(flat.size, world)
     padded = np.zeros(chunk * world, np.float32)
     padded[: flat.size] = flat
     sends = []
@@ -97,6 +213,18 @@ def allreduce_quantized(
     reduced arrays (same shapes/dtypes as inputs)."""
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
+
+    if _is_device_tree(arrays):
+        dflat, dshapes, ddtypes = _flatten_jax(arrays)
+
+        def run_device() -> List[Any]:
+            if pg.size() <= 1:
+                return _unflatten_jax(dflat, dshapes, ddtypes)
+            return _allreduce_quantized_device(
+                dflat, dshapes, ddtypes, op, pg, row
+            )
+
+        return _run_async(run_device)
 
     flat, shapes, dtypes = _flatten(arrays)
 

@@ -503,16 +503,29 @@ class Manager:
         if self.errored():
             return DummyWork(zeros())
 
+        if should_quantize and getattr(self._pg, "device_native", False):
+            # fp8 compression exists to save host/DCN wire bandwidth; the
+            # device plane's collectives already ride ICI/DCN natively and
+            # don't speak the host wire-tuple format.
+            if not getattr(self, "_warned_quantize_device_native", False):
+                self._warned_quantize_device_native = True
+                self._logger.warning(
+                    "should_quantize ignored: PG is device-native"
+                )
+            should_quantize = False
+
         self.wait_quorum()
         num_participants = self.num_participants()
 
         # Device-native PGs (ProcessGroupXLA) take jax.Arrays straight
         # through — the collective runs on device over ICI/DCN with no
         # host staging (VERDICT weak #4: the D2H round-trip on the caller
-        # thread). Host-plane PGs get the numpy staging they require.
-        # Quantized collectives currently reduce on host either way.
-        device_native = (
-            getattr(self._pg, "device_native", False) and not should_quantize
+        # thread). The quantized path likewise keeps jax.Arrays on device:
+        # the Pallas kernels quantize there and only the compressed payload
+        # crosses to the host wire (collectives.py). Host-plane PGs with
+        # plain numpy inputs get the numpy staging they require.
+        device_native = getattr(self._pg, "device_native", False) or (
+            should_quantize and all(isinstance(l, jax.Array) for l in leaves)
         )
         if device_native:
             import jax.numpy as jnp

@@ -517,7 +517,11 @@ def _ring_allreduce(comm: "_Comm", leaves: List[np.ndarray], op: ReduceOp) -> Li
         ofs = 0
         for i in idxs:
             n = leaves[i].size
-            out[i] = buf[ofs:ofs + n].reshape(leaves[i].shape)
+            # copy: returned leaves must be independent arrays (the exchange
+            # path's contract) — views into the shared flat buffer would
+            # alias each other under callers' in-place updates and pin the
+            # whole padded buffer alive
+            out[i] = buf[ofs:ofs + n].reshape(leaves[i].shape).copy()
             ofs += n
 
     return out  # type: ignore[return-value]

@@ -327,23 +327,6 @@ class ProcessGroupXLA(ProcessGroup):
         unboundedly at first materialization."""
         import queue as _queue
 
-        with self._lock:
-            if self._dispatch_q is None:
-                q: "_queue.Queue" = _queue.Queue()
-                self._dispatch_q = q
-
-                def pump() -> None:
-                    while True:
-                        item = q.get()
-                        if item is None:
-                            return
-                        item()
-
-                threading.Thread(
-                    target=pump, daemon=True, name="pgxla_dispatch"
-                ).start()
-            q = self._dispatch_q
-
         fut: Future = Future()
         timeout = self._timeout
 
@@ -363,7 +346,27 @@ class ProcessGroupXLA(ProcessGroup):
                 except RuntimeError:
                     pass
 
-        q.put(run)
+        # enqueue under the lock: abort() swaps _dispatch_q and posts the
+        # shutdown sentinel under the same lock, so an op can never land
+        # behind the sentinel and leave its future unresolved
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            if self._dispatch_q is None:
+                q: "_queue.Queue" = _queue.Queue()
+                self._dispatch_q = q
+
+                def pump() -> None:
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        item()
+
+                threading.Thread(
+                    target=pump, daemon=True, name="pgxla_dispatch"
+                ).start()
+            self._dispatch_q.put(run)
         return FutureWork(fut)
 
     # ------------------------------------------------------------ lifecycle

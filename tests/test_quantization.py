@@ -139,8 +139,13 @@ class TestQuantizedCollectives:
             pg.shutdown()
 
     def test_reduce_scatter_quantized(self, store):
+        """Chunk ownership is row-aligned (512-element fp8 rows) so device-
+        and host-quantizing ranks always exchange identically-partitioned
+        chunks; rank r owns padded elements [r*chunk, (r+1)*chunk)."""
         pgs = make_pgs(store, 2, quorum_id=33)
-        inputs = [[np.arange(8, dtype=np.float32)], [np.arange(8, dtype=np.float32)]]
+        n = 1500  # chunk = ceil(ceil(1500/2)/512)*512 = 1024
+        vals = np.linspace(0, 10, n).astype(np.float32)
+        inputs = [[vals], [vals]]
 
         def run(rank):
             return (
@@ -151,9 +156,11 @@ class TestQuantizedCollectives:
 
         with ThreadPoolExecutor(max_workers=2) as ex:
             outs = list(ex.map(run, range(2)))
-        full = np.arange(8, dtype=np.float32) * 2
-        np.testing.assert_allclose(outs[0], full[:4], rtol=0.07, atol=0.01)
-        np.testing.assert_allclose(outs[1], full[4:], rtol=0.07, atol=0.01)
+        full = np.zeros(2048, np.float32)
+        full[:n] = vals * 2
+        assert outs[0].shape == (1024,) and outs[1].shape == (1024,)
+        np.testing.assert_allclose(outs[0], full[:1024], rtol=0.07, atol=0.05)
+        np.testing.assert_allclose(outs[1], full[1024:], rtol=0.07, atol=0.05)
         for pg in pgs:
             pg.shutdown()
 
@@ -240,6 +247,37 @@ class TestDeviceQuantizedPath:
                 )
         for pg in pgs:
             pg.shutdown()
+
+
+
+    def test_mixed_device_host_ranks_agree(self, store):
+        """One rank quantizes on device (jax inputs), the other on host
+        (numpy inputs): chunk partitioning must align (row-rounded on both
+        paths) so the reduction is correct, with an element count that is
+        neither row- nor world-aligned."""
+        import jax.numpy as jnp
+
+        pgs = make_pgs(store, 2, quorum_id=43)
+        n = 740  # 2 ranks, row=512: forces padding on both axes
+        base = np.linspace(-3, 3, n).astype(np.float32)
+        inputs = [jnp.asarray(base), base * 2]
+        expected = base * 3
+
+        def run(rank):
+            return (
+                allreduce_quantized([inputs[rank]], ReduceOp.SUM, pgs[rank])
+                .get_future().wait(timeout=60)
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            outs = list(ex.map(run, range(2)))
+        for out in outs:
+            np.testing.assert_allclose(
+                np.asarray(out[0]), expected, rtol=0.1, atol=0.05
+            )
+        for pg in pgs:
+            pg.shutdown()
+
 
     def test_numpy_inputs_keep_host_path(self, store, monkeypatch):
         import torchft_tpu.collectives as coll

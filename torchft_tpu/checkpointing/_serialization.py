@@ -19,10 +19,12 @@ import numpy as np
 __all__ = [
     "TensorMeta",
     "TreeSpecPayload",
+    "alloc_leaf",
     "flatten_state",
     "unflatten_state",
     "leaf_to_bytes",
     "leaf_from_bytes",
+    "payload_memoryview",
     "split_chunks",
 ]
 
@@ -53,27 +55,32 @@ def _is_array(x: Any) -> bool:
     return isinstance(x, np.ndarray) or type(x).__module__.startswith("jax")
 
 
-def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[bytes]]:
+def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
     """Flatten a pytree into (spec, per-leaf payloads).
 
-    Array leaves (numpy or jax) are staged to host and serialized as raw
-    buffers; other leaves are pickled.
+    Array leaves (numpy or jax) are staged to host and kept as **arrays**
+    (a zero-copy view for numpy inputs; one D2H for jax) — NOT serialized
+    to bytes here. Transports stream straight from the array memory, so
+    peak host memory stays ~1x the payload instead of the 2-3x that
+    pre-serializing every leaf costs (VERDICT round-2 item 6). Non-array
+    leaves are pickled bytes.
     """
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(state)
     metas: List[TensorMeta] = []
-    payloads: List[bytes] = []
+    payloads: List[Any] = []
     for leaf in leaves:
         if _is_array(leaf):
-            host = np.asarray(leaf)
-            buf = host.tobytes()
+            host = np.ascontiguousarray(np.asarray(leaf))
             metas.append(
                 TensorMeta(
-                    dtype=str(host.dtype), shape=tuple(host.shape), nbytes=len(buf)
+                    dtype=str(host.dtype),
+                    shape=tuple(host.shape),
+                    nbytes=host.nbytes,
                 )
             )
-            payloads.append(buf)
+            payloads.append(host)
         else:
             buf = pickle.dumps(leaf)
             metas.append(
@@ -84,24 +91,59 @@ def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[bytes]]:
     return spec, payloads
 
 
+def payload_memoryview(payload: Any) -> memoryview:
+    """A flat byte view of a staged payload (array or bytes) — what the
+    transports put on the wire, with no serialization copy."""
+    if isinstance(payload, np.ndarray):
+        # reshape(-1) first: numpy rejects dtype-changing views of 0-d
+        # arrays (scalar leaves like an optax step count)
+        return memoryview(payload.reshape(-1).view(np.uint8))
+    return memoryview(payload)
+
+
 def leaf_to_bytes(leaf: Any) -> bytes:
     if _is_array(leaf):
         return np.asarray(leaf).tobytes()
     return pickle.dumps(leaf)
 
 
-def leaf_from_bytes(meta: TensorMeta, buf: bytes) -> Any:
+def leaf_from_bytes(meta: TensorMeta, buf: Any) -> Any:
+    """Rebuild a leaf from a received buffer (bytes, bytearray, or a uint8
+    ndarray straight off a PG recv)."""
     if meta.kind == "pickled":
-        return pickle.loads(buf)
-    arr = np.frombuffer(buf, dtype=np.dtype(meta.dtype)).reshape(meta.shape)
-    return arr.copy()  # own the memory (buf may be a transient view)
+        return pickle.loads(bytes(buf))
+    dtype = _np_dtype_from_str(meta.dtype)
+    if isinstance(buf, np.ndarray):
+        arr = buf.reshape(-1).view(np.uint8).view(dtype).reshape(meta.shape)
+        return arr if buf.flags.owndata else arr.copy()
+    arr = np.frombuffer(buf, dtype=dtype).reshape(meta.shape)
+    # bytes may be a transient view (copy); a bytearray from a streamed
+    # recv was allocated for this leaf and stays alive via arr.base
+    return arr.copy() if isinstance(buf, bytes) else arr
 
 
-def unflatten_state(spec: TreeSpecPayload, payloads: Sequence[bytes]) -> Any:
+def _np_dtype_from_str(name: str) -> np.dtype:
+    from torchft_tpu.utils import np_dtype_from_str
+
+    return np_dtype_from_str(name)
+
+
+def alloc_leaf(meta: TensorMeta) -> np.ndarray:
+    """Preallocate the final array for a streamed receive — the receiver
+    reads the wire straight into this memory (readinto), so peak overhead
+    stays O(stream buffer), not O(payload)."""
+    return np.empty(meta.shape, _np_dtype_from_str(meta.dtype))
+
+
+def unflatten_state(spec: TreeSpecPayload, payloads: Sequence[Any]) -> Any:
     import jax
 
     treedef = pickle.loads(spec.treedef_bytes)
-    leaves = [leaf_from_bytes(m, b) for m, b in zip(spec.leaves, payloads)]
+    leaves = [
+        b if (isinstance(b, np.ndarray) and m.kind == "array")
+        else leaf_from_bytes(m, b)
+        for m, b in zip(spec.leaves, payloads)
+    ]
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

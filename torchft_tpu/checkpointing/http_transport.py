@@ -5,6 +5,14 @@ Design mirror of the reference HTTPTransport
 serving ``/checkpoint/{step}/{metadata|chunk_{i}}``, gated by an RWLock so
 serving can be disallowed while the optimizer mutates state; receivers fetch
 chunks in parallel and reassemble the pytree.
+
+Both directions stream (reference `_streaming_save/_load`,
+http_transport.py:219-266): the sender serves leaf payloads straight from
+the staged host arrays — one [leaf_idx, nbytes] frame header then the raw
+buffer per leaf, no pre-pickled chunk bodies — and the receiver reads each
+frame directly into the leaf's final preallocated array (``readinto``).
+Peak host overhead is O(stream buffer), not O(payload), which is what makes
+12GB-class state dicts transferable at 8B scale.
 """
 
 from __future__ import annotations
@@ -12,6 +20,7 @@ from __future__ import annotations
 import logging
 import pickle
 import socket
+import struct
 import threading
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -22,7 +31,9 @@ from typing import Any, List, Optional
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing._serialization import (
     TreeSpecPayload,
+    alloc_leaf,
     flatten_state,
+    payload_memoryview,
     split_chunks,
     unflatten_state,
 )
@@ -31,6 +42,8 @@ from torchft_tpu.checkpointing.transport import CheckpointTransport
 logger = logging.getLogger(__name__)
 
 __all__ = ["HTTPTransport"]
+
+_FRAME = struct.Struct("<qq")  # leaf_idx, nbytes
 
 
 def _to_seconds(timeout: "float | timedelta") -> float:
@@ -54,7 +67,8 @@ class HTTPTransport(CheckpointTransport[Any]):
 
         self._step: Optional[int] = None
         self._spec: Optional[TreeSpecPayload] = None
-        self._chunks: Optional[List[bytes]] = None  # pre-assembled chunk bodies
+        self._payloads: Optional[List[Any]] = None  # staged host arrays/bytes
+        self._assignments: Optional[List[List[int]]] = None  # chunk -> leaves
 
         # Delivery tracking: how many chunk fetches we expect for the staged
         # step vs. how many were served. disallow_checkpoint() grants a grace
@@ -74,6 +88,11 @@ class HTTPTransport(CheckpointTransport[Any]):
 
             def do_GET(self) -> None:
                 try:
+                    # bound the streamed write: the chunk body is written
+                    # while holding the state read lock, so a stalled
+                    # receiver must time out rather than wedge
+                    # disallow_checkpoint's write-acquire forever
+                    self.connection.settimeout(transport._timeout)
                     parts = self.path.strip("/").split("/")
                     # /checkpoint/{step}/{what}
                     if len(parts) != 3 or parts[0] != "checkpoint":
@@ -82,6 +101,9 @@ class HTTPTransport(CheckpointTransport[Any]):
                     step = int(parts[1])
                     what = parts[2]
                     try:
+                        # the read lock is held across the whole streamed
+                        # write: disallow_checkpoint cannot yank the staged
+                        # arrays out from under an in-flight response
                         with transport._state_lock.r_lock(timeout=transport._timeout):
                             if transport._step != step:
                                 self.send_error(
@@ -89,20 +111,14 @@ class HTTPTransport(CheckpointTransport[Any]):
                                     f"serving step {transport._step}, asked {step}",
                                 )
                                 return
-                            body = transport._body_for(what)
+                            if not transport._stream_response(self, what):
+                                self.send_error(404, f"unknown resource {what}")
+                                return
                     except TimeoutError:
                         self.send_error(503, "checkpoint not available (locked)")
                         return
-                    if body is None:
-                        self.send_error(404, f"unknown resource {what}")
-                        return
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/octet-stream")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except BrokenPipeError:
-                    pass
+                except (BrokenPipeError, socket.timeout):
+                    pass  # receiver gone or stalled past the timeout
                 except Exception as e:  # noqa: BLE001
                     logger.exception("http_transport handler failed")
                     try:
@@ -118,18 +134,43 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._serve_thread.start()
 
     # -- serving side -----------------------------------------------------
-    def _body_for(self, what: str) -> Optional[bytes]:
-        assert self._spec is not None and self._chunks is not None
+    def _stream_response(self, handler: Any, what: str) -> bool:
+        """Write the response for ``what`` (True if the resource exists).
+
+        Chunk bodies stream straight from the staged arrays: per leaf a
+        16-byte [leaf_idx, nbytes] frame then the raw buffer — never
+        assembled in memory."""
+        assert self._spec is not None
+        assert self._payloads is not None and self._assignments is not None
         if what == "metadata":
-            return pickle.dumps((self._spec, len(self._chunks)))
+            body = pickle.dumps((self._spec, len(self._assignments)))
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/octet-stream")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return True
         if what.startswith("chunk_"):
             i = int(what[len("chunk_"):])
-            if 0 <= i < len(self._chunks):
-                with self._fetch_cond:
-                    self._served_fetches += 1
-                    self._fetch_cond.notify_all()
-                return self._chunks[i]
-        return None
+            if not (0 <= i < len(self._assignments)):
+                return False
+            idxs = self._assignments[i]
+            total = sum(
+                _FRAME.size + self._spec.leaves[j].nbytes for j in idxs
+            )
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/octet-stream")
+            handler.send_header("Content-Length", str(total))
+            handler.end_headers()
+            for j in idxs:
+                mv = payload_memoryview(self._payloads[j])
+                handler.wfile.write(_FRAME.pack(j, len(mv)))
+                handler.wfile.write(mv)
+            with self._fetch_cond:
+                self._served_fetches += 1
+                self._fetch_cond.notify_all()
+            return True
+        return False
 
     def metadata(self) -> str:
         host = socket.gethostname()
@@ -146,15 +187,13 @@ class HTTPTransport(CheckpointTransport[Any]):
         """
         spec, payloads = flatten_state(state_dict)
         num = self._num_chunks or 1
-        assignments = split_chunks([len(p) for p in payloads], num)
-        chunks = [
-            pickle.dumps([(i, payloads[i]) for i in idxs]) for idxs in assignments
-        ]
+        assignments = split_chunks([m.nbytes for m in spec.leaves], num)
         self._step = step
         self._spec = spec
-        self._chunks = chunks
+        self._payloads = payloads
+        self._assignments = assignments
         with self._fetch_cond:
-            self._expected_fetches = len(chunks) * max(len(dst_ranks), 0)
+            self._expected_fetches = len(assignments) * max(len(dst_ranks), 0)
             self._served_fetches = 0
         if not self._have_state:
             self._have_state = True
@@ -176,7 +215,8 @@ class HTTPTransport(CheckpointTransport[Any]):
                 )
             self._have_state = False
             self._spec = None
-            self._chunks = None
+            self._payloads = None
+            self._assignments = None
             self._step = None
 
     # -- receiving side ---------------------------------------------------
@@ -189,14 +229,37 @@ class HTTPTransport(CheckpointTransport[Any]):
                 return r.read()
 
         spec, num_chunks = pickle.loads(fetch(f"{base}/metadata"))
-        payloads: List[Optional[bytes]] = [None] * len(spec.leaves)
+        payloads: List[Optional[Any]] = [None] * len(spec.leaves)
+
+        def fetch_chunk(i: int) -> None:
+            """Stream one chunk: read each [leaf_idx, nbytes] frame, then
+            read the body straight into the leaf's final array."""
+            with urllib.request.urlopen(
+                f"{base}/chunk_{i}", timeout=timeout_s
+            ) as r:
+                while True:
+                    hdr = r.read(_FRAME.size)
+                    if not hdr:
+                        return
+                    leaf_idx, nbytes = _FRAME.unpack(hdr)
+                    meta = spec.leaves[leaf_idx]
+                    if meta.kind == "array":
+                        arr = alloc_leaf(meta)
+                        mv = memoryview(arr.reshape(-1).view("u1"))
+                        got = 0
+                        while got < nbytes:
+                            n = r.readinto(mv[got:])
+                            if not n:
+                                raise ConnectionError(
+                                    f"chunk {i} truncated at leaf {leaf_idx}"
+                                )
+                            got += n
+                        payloads[leaf_idx] = arr
+                    else:
+                        payloads[leaf_idx] = r.read(nbytes)
+
         with ThreadPoolExecutor(max_workers=max(1, min(num_chunks, 8))) as ex:
-            bodies = list(
-                ex.map(lambda i: fetch(f"{base}/chunk_{i}"), range(num_chunks))
-            )
-        for body in bodies:
-            for leaf_idx, buf in pickle.loads(body):
-                payloads[leaf_idx] = buf
+            list(ex.map(fetch_chunk, range(num_chunks)))
         missing = [i for i, p in enumerate(payloads) if p is None]
         if missing:
             raise RuntimeError(f"checkpoint chunks missing leaves {missing}")

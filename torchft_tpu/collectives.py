@@ -188,9 +188,13 @@ def _reduce_scatter_core(
     flat: np.ndarray, op: ReduceOp, pg: ProcessGroup, row: int
 ) -> tuple[np.ndarray, int]:
     """Shared pipeline: pad -> per-dest-chunk quantize -> alltoall -> f32
-    accumulate (-> AVG). Returns (this rank's reduced f32 chunk, chunk size)."""
+    accumulate (-> AVG). Returns (this rank's reduced f32 chunk, chunk size).
+
+    Chunks are rounded up to whole fp8 rows — the SAME partitioning as the
+    device (Pallas) path, so a quorum where some ranks quantize on device
+    and others on host exchanges identically-aligned chunks."""
     world = pg.size()
-    chunk = _ceil_div(flat.size, world)
+    chunk = max(1, _ceil_div(_ceil_div(flat.size, world), row)) * row
     padded = np.zeros(chunk * world, np.float32)
     padded[: flat.size] = flat
     sends = []

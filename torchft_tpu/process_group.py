@@ -361,7 +361,7 @@ class _Comm:
         through a uint8 view — memoryview can't export extended dtypes like
         ml_dtypes.bfloat16 (the dominant TPU gradient dtype)."""
         if isinstance(buf, np.ndarray):
-            buf = buf.view(np.uint8)
+            buf = buf.reshape(-1).view(np.uint8)  # reshape first: 0-d safe
         mv = memoryview(buf).cast("B")
         sock = self.peers[peer]
         sock.sendall(_HDR.pack(len(mv)))
@@ -374,7 +374,7 @@ class _Comm:
         sock = self.peers[peer]
         (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
         if isinstance(out, np.ndarray):
-            out = out.view(np.uint8)
+            out = out.reshape(-1).view(np.uint8)
         mv = memoryview(out).cast("B")
         if length != len(mv):
             raise ValueError(f"frame size {length} != buffer size {len(mv)}")
@@ -739,16 +739,37 @@ class ProcessGroupHost(ProcessGroup):
         host = [_to_host(a) for a in arrays]
 
         def _run(comm):
-            comm.send_to(dst, ("p2p", tag, host))
+            if all(isinstance(h, np.ndarray) for h in host) and (
+                sum(h.nbytes for h in host) >= _RING_MIN_BYTES
+            ):
+                # raw-frame p2p: a small pickled header with dtype/shape
+                # metas, then each leaf's bytes straight from its memory —
+                # no pickling copy of multi-GB checkpoint leaves
+                metas = [(str(h.dtype), h.shape) for h in host]
+                comm.send_to(dst, ("p2p_raw", tag, metas))
+                for h in host:
+                    comm.send_raw(dst, np.ascontiguousarray(h))
+            else:
+                comm.send_to(dst, ("p2p", tag, host))
             return None
 
         return self._submit(_run, "send")
 
     def recv(self, src, tag=0):
         def _run(comm):
-            kind, got_tag, host = comm.recv_from(src)
-            assert kind == "p2p" and got_tag == tag, (kind, got_tag, tag)
-            return host
+            kind, got_tag, payload = comm.recv_from(src)
+            assert got_tag == tag, (kind, got_tag, tag)
+            if kind == "p2p":
+                return payload
+            assert kind == "p2p_raw", kind
+            from torchft_tpu.utils import np_dtype_from_str
+
+            out = []
+            for dtype_str, shape in payload:
+                arr = np.empty(shape, np_dtype_from_str(dtype_str))
+                comm.recv_raw_into(src, arr)
+                out.append(arr)
+            return out
 
         return self._submit(_run, "recv")
 

@@ -43,6 +43,19 @@ def force_virtual_cpu_devices(n: int) -> None:
         pass  # backend already initialised; caller's device check reports it
 
 
+def np_dtype_from_str(name: str):
+    """np.dtype for a dtype name, including ml_dtypes extended types
+    (bfloat16, float8_*) that plain np.dtype() doesn't know."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def peak_flops_per_chip() -> float:
     """Dense bf16 peak FLOP/s of the local chip, by device kind.
 

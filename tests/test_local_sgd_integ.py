@@ -33,7 +33,8 @@ def run_threads(fns):
         return [f.result(timeout=120) for f in futs]
 
 
-def make_manager(replica_id, lighthouse, state_holder, use_async_quorum=False):
+def make_manager(replica_id, lighthouse, state_holder, use_async_quorum=False,
+                 pg=None):
     def load_state(sd):
         state_holder["params"] = {
             k: np.asarray(v) for k, v in sd["params"].items()
@@ -43,7 +44,7 @@ def make_manager(replica_id, lighthouse, state_holder, use_async_quorum=False):
         return {"params": dict(state_holder["params"])}
 
     return Manager(
-        pg=ProcessGroupHost(timeout=10.0),
+        pg=pg or ProcessGroupHost(timeout=10.0),
         load_state_dict=load_state,
         state_dict=save_state,
         min_replica_size=1,
@@ -140,3 +141,193 @@ class TestLocalSGDInteg:
         results = run_threads([lambda r=r: replica(r) for r in range(2)])
         assert injector.count == 1
         np.testing.assert_array_equal(results[0], results[1])
+
+
+class TestStreamingDiLoCoScenarios:
+    """Reference-parity streaming-DiLoCo scenarios
+    (torchft local_sgd_integ_test.py:174-599): upscale while running,
+    commit failure -> quorum bump -> fragment restore, and recovery
+    landing mid-fragment-cycle."""
+
+    OUTER_TARGET = 4  # outer (committed) steps per replica
+
+    def _diloco_loop(self, rid, lighthouse, state, injector=None, pg=None,
+                     num_fragments=1, sync_every=SYNC_EVERY, drift=0.1,
+                     target=None, per_cycle_hook=None):
+        manager = make_manager(rid, lighthouse, state, use_async_quorum=False,
+                               pg=pg)
+        target = target if target is not None else self.OUTER_TARGET
+        try:
+            diloco = DiLoCo(
+                manager, state["params"], outer_tx=optax.sgd(1.0),
+                sync_every=sync_every, num_fragments=num_fragments,
+            )
+            inner = 0
+            while manager.current_step() < target:
+                if per_cycle_hook is not None:
+                    per_cycle_hook(manager)
+                if injector is not None:
+                    injector.check(rid, inner, pg)
+                state["params"] = {
+                    "w": state["params"]["w"] - drift * (rid + 1)
+                }
+                state["params"] = diloco.step(state["params"])
+                inner += 1
+            return manager
+        except BaseException:
+            manager.shutdown(wait=False)
+            raise
+        finally:
+            if manager.current_step() >= target:
+                manager.shutdown(wait=False)
+
+    def test_upscale_while_running(self, lighthouse):
+        """Replica 1 joins after replica 0 has already committed outer
+        steps; it must heal (live checkpoint from replica 0, landing at
+        replica 0's step) and converge to bitwise-identical params."""
+        import threading
+        import time
+
+        joiner_manager_up = threading.Event()
+        r0_progress = {"step": 0}
+        target = 6
+
+        def replica0():
+            state = {"params": {"w": np.array([0.0], dtype=np.float32)}}
+
+            def pause_for_joiner(manager):
+                # publish progress; once past 3 solo commits, hold until
+                # the late replica's manager exists so the remaining
+                # quorums are joint (the joiner heals into this step)
+                r0_progress["step"] = manager.current_step()
+                if manager.current_step() >= 3:
+                    assert joiner_manager_up.wait(timeout=30), (
+                        "joiner never started"
+                    )
+
+            m = self._diloco_loop(
+                0, lighthouse, state, target=target,
+                per_cycle_hook=pause_for_joiner,
+            )
+            return state["params"]["w"].copy(), m.current_step()
+
+        def replica1():
+            # join only after replica 0 has genuinely committed solo steps
+            deadline = time.monotonic() + 30
+            while r0_progress["step"] < 2:
+                assert time.monotonic() < deadline, "replica 0 never progressed"
+                time.sleep(0.02)
+            state = {"params": {"w": np.array([0.0], dtype=np.float32)}}
+            m = self._diloco_loop(
+                1, lighthouse, state, target=target,
+                per_cycle_hook=lambda manager: joiner_manager_up.set(),
+            )
+            return state["params"]["w"].copy(), m.current_step()
+
+        results = run_threads([replica0, replica1])
+        (w0, s0), (w1, s1) = results
+        assert s0 >= target and s1 >= target
+        np.testing.assert_array_equal(w0, w1)
+
+    def test_commit_failure_restores_fragment_and_recovers(self, lighthouse):
+        """An injected allreduce failure at a sync step must discard the
+        cycle (should_commit False -> fragment restore), bump the quorum,
+        and leave both replicas bitwise-equal afterwards — with exactly one
+        cycle's worth of outer updates missing."""
+        from torchft_tpu.process_group import FakeProcessGroupWrapper
+
+        injector = EventInjector().fail_allreduce_at(replica=0, step=1)
+        fakes = [FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
+                 for _ in range(2)]
+
+        def replica(rid):
+            state = {"params": {"w": np.array([0.0], dtype=np.float32)}}
+            manager = self._diloco_loop(
+                rid, lighthouse, state, injector=injector, pg=fakes[rid]
+            )
+            return state["params"]["w"].copy(), manager
+
+        results = run_threads([lambda r=r: replica(r) for r in range(2)])
+        assert injector.count == 1
+        (w0, m0), (w1, m1) = results
+        # The poisoned allreduce (zeros-swallowed on replica 0 only) must
+        # never land asymmetrically: bitwise equality is the corruption
+        # detector.
+        np.testing.assert_array_equal(w0, w1)
+        # A healthy full cycle applies avg pseudograd -0.3; the failed
+        # cycle is discarded (restored), so the result stays within one
+        # cycle of the nominal OUTER_TARGET * -0.3 — a corrupt commit
+        # (zeros averaged in, or double-applied drift) falls outside.
+        nominal = -0.3 * self.OUTER_TARGET
+        assert nominal - 0.3 <= float(w0[0]) <= nominal + 0.3, w0
+
+    def test_crash_mid_fragment_cycle_streaming(self):
+        """Streaming DiLoCo (2 fragments, staggered syncs): replica 1 dies
+        between the two fragments' sync points, rejoins, heals, and both
+        replicas end bitwise-equal.
+
+        min_replicas=2: the commits must be joint — with singleton quorums
+        allowed, the survivor's fast solo cycling can starve the rejoining
+        replica out of ever merging quorums, which is a different scenario
+        (covered by test_upscale_while_running)."""
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=5000,
+            quorum_tick_ms=20, heartbeat_timeout_ms=1500,
+        )
+        injector = EventInjector().fail_at(replica=1, step=5)
+        # each replica keeps stepping (joint quorums) until BOTH reached the
+        # target — otherwise the first finisher's exit leaves the other
+        # committing solo tail cycles with different averages
+        progress = {0: 0, 1: 0}
+
+        def replica(rid):
+            for attempt in range(3):
+                state = {"params": {
+                    "w": np.zeros(4, dtype=np.float32),
+                    "v": np.zeros(4, dtype=np.float32),
+                }}
+                manager = make_manager(rid, lighthouse, state,
+                                       use_async_quorum=False)
+                try:
+                    diloco = DiLoCo(
+                        manager, state["params"], outer_tx=optax.sgd(1.0),
+                        sync_every=4, num_fragments=2,
+                    )
+                    inner = 0
+                    while (
+                        manager.current_step() < self.OUTER_TARGET
+                        or min(progress.values()) < self.OUTER_TARGET
+                    ):
+                        progress[rid] = manager.current_step()
+                        injector.check(rid, inner)
+                        state["params"] = {
+                            k: v - 0.1 * (rid + 1)
+                            for k, v in state["params"].items()
+                        }
+                        state["params"] = diloco.step(state["params"])
+                        inner += 1
+                    progress[rid] = manager.current_step()
+                    # Between staggered syncs the LOCAL params legitimately
+                    # carry per-replica inner drift; the replicated object
+                    # streaming DiLoCo maintains is each fragment's GLOBAL
+                    # ("original") params — that's what must match.
+                    return [
+                        [p.copy() for p in frag.original]
+                        for frag in diloco.fragments
+                    ]
+                except InjectedFailure:
+                    progress[rid] = 0
+                    continue
+                finally:
+                    manager.shutdown(wait=False)
+            raise RuntimeError("attempts exhausted")
+
+        try:
+            results = run_threads([lambda r=r: replica(r) for r in range(2)])
+        finally:
+            lighthouse.shutdown()
+        assert injector.count == 1
+        assert len(results[0]) == 2  # two fragments
+        for frag0, frag1 in zip(results[0], results[1]):
+            for p0, p1 in zip(frag0, frag1):
+                np.testing.assert_array_equal(p0, p1)

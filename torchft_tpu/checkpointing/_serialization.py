@@ -72,7 +72,15 @@ def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
     payloads: List[Any] = []
     for leaf in leaves:
         if _is_array(leaf):
-            host = np.ascontiguousarray(np.asarray(leaf))
+            if isinstance(leaf, np.ndarray):
+                # snapshot: a live numpy leaf may be mutated in place by the
+                # training loop while the serving window is open — streaming
+                # an alias would tear the checkpoint mid-leaf
+                host = np.array(leaf, copy=True)
+            else:
+                # jax.Array: np.asarray materializes a fresh host buffer
+                # (one D2H, no alias back to trainer state) — zero extra copy
+                host = np.ascontiguousarray(np.asarray(leaf))
             metas.append(
                 TensorMeta(
                     dtype=str(host.dtype),

@@ -100,23 +100,38 @@ class HTTPTransport(CheckpointTransport[Any]):
                         return
                     step = int(parts[1])
                     what = parts[2]
+                    # Acquire the read lock OUTSIDE the streaming block:
+                    # socket.timeout IS TimeoutError (py>=3.10), so a
+                    # mid-stream write timeout must never reach a handler
+                    # that answers with send_error — a 503 page injected
+                    # into the middle of the frame stream would parse as
+                    # leaf payload on the receiver.
+                    if not transport._state_lock.r_acquire(
+                        timeout=transport._timeout
+                    ):
+                        self.send_error(503, "checkpoint not available (locked)")
+                        return
                     try:
                         # the read lock is held across the whole streamed
                         # write: disallow_checkpoint cannot yank the staged
                         # arrays out from under an in-flight response
-                        with transport._state_lock.r_lock(timeout=transport._timeout):
-                            if transport._step != step:
-                                self.send_error(
-                                    400,
-                                    f"serving step {transport._step}, asked {step}",
-                                )
-                                return
-                            if not transport._stream_response(self, what):
-                                self.send_error(404, f"unknown resource {what}")
-                                return
-                    except TimeoutError:
-                        self.send_error(503, "checkpoint not available (locked)")
+                        if transport._step != step:
+                            self.send_error(
+                                400,
+                                f"serving step {transport._step}, asked {step}",
+                            )
+                            return
+                        if not transport._stream_response(self, what):
+                            self.send_error(404, f"unknown resource {what}")
+                            return
+                    except (BrokenPipeError, TimeoutError, OSError):
+                        # receiver gone or stalled past the socket timeout:
+                        # drop the connection; never write an error page
+                        # into a partially-streamed body
+                        self.close_connection = True
                         return
+                    finally:
+                        transport._state_lock.r_release()
                 except (BrokenPipeError, socket.timeout):
                     pass  # receiver gone or stalled past the timeout
                 except Exception as e:  # noqa: BLE001

@@ -1,0 +1,118 @@
+"""Slurm launch path for the Llama-3-8B FT-HSDP target.
+
+Role-equivalent of the reference's slurm runner
+(torchft/examples/slurm/runner.py:23-60): submit one scheduler job per
+replica group plus the lighthouse, each carrying the framework's env
+contract (torchft_tpu/launcher.py:39-43). TPU clusters are usually GKE
+(see gke_runner.py); this covers slurm-managed TPU-VM fleets.
+
+Dry-run friendly: ``--dry-run`` prints the sbatch scripts instead of
+submitting, so the launch path is reviewable without a cluster:
+
+    python examples/cluster/slurm_runner.py --replica-groups 4 --dry-run
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+LIGHTHOUSE_SBATCH = """\
+#!/bin/bash
+#SBATCH --job-name=torchft-lighthouse
+#SBATCH --nodes=1
+#SBATCH --output=lighthouse.log
+exec python -m torchft_tpu.lighthouse \\
+    --bind=0.0.0.0:{port} --min-replicas={min_replicas} \\
+    --join-timeout-ms=60000 --quorum-tick-ms=100 --heartbeat-timeout-ms=5000
+"""
+
+REPLICA_SBATCH = """\
+#!/bin/bash
+#SBATCH --job-name=torchft-replica-{rid}
+#SBATCH --nodes=1
+#SBATCH --output=replica_{rid}_%j.log
+#SBATCH --requeue
+export TORCHFT_LIGHTHOUSE={lighthouse_host}:{port}
+export REPLICA_GROUP_ID={rid}
+export NUM_REPLICA_GROUPS={num_groups}
+export GROUP_RANK=0
+export GROUP_WORLD_SIZE=1
+exec python {train_script} \\
+    {config_arg}--batch-size={local_batch_size} --steps={steps}{extra}
+"""
+
+
+def build_scripts(args: argparse.Namespace) -> "list[tuple[str, str]]":
+    scripts = [
+        (
+            "lighthouse.sbatch",
+            LIGHTHOUSE_SBATCH.format(
+                port=args.port, min_replicas=args.min_replicas
+            ),
+        )
+    ]
+    train_script = "examples/train_llama_hsdp.py"
+    config_arg = f"--config={args.model_config} "
+    extra = ""
+    if args.semi_sync_method == "diloco":
+        # same Llama trainer, semi-sync mode (reference config)
+        extra = (" \\\n    --diloco --sync-every=20 --num-fragments=2"
+                 " --fragment-sync-delay=1")
+    for rid in range(args.replica_groups):
+        scripts.append(
+            (
+                f"replica_{rid}.sbatch",
+                REPLICA_SBATCH.format(
+                    rid=rid,
+                    lighthouse_host=args.lighthouse_host,
+                    port=args.port,
+                    num_groups=args.replica_groups,
+                    train_script=train_script,
+                    config_arg=config_arg,
+                    local_batch_size=args.local_batch_size,
+                    steps=args.steps,
+                    extra=extra,
+                ),
+            )
+        )
+    return scripts
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replica-groups", type=int, default=4)
+    p.add_argument("--min-replicas", type=int, default=2)
+    p.add_argument(
+        "--lighthouse-host", default=None,
+        help="hostname running the lighthouse job (REQUIRED to submit: each "
+             "sbatch job is its own allocation, so no in-script expansion "
+             "can discover the lighthouse's node)",
+    )
+    p.add_argument("--port", type=int, default=29510)
+    p.add_argument("--model-config", default="llama3_8b")
+    p.add_argument("--local-batch-size", type=int, default=2)
+    p.add_argument("--steps", type=int, default=10000)
+    p.add_argument("--semi-sync-method", choices=["none", "diloco"],
+                   default="none")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+    if args.lighthouse_host is None:
+        if not args.dry_run:
+            p.error("--lighthouse-host is required to submit")
+        args.lighthouse_host = "LIGHTHOUSE_HOST"  # review placeholder
+
+    for name, text in build_scripts(args):
+        if args.dry_run:
+            sys.stdout.write(f"# === {name} ===\n{text}\n")
+        else:
+            with open(name, "w") as f:
+                f.write(text)
+            subprocess.run(["sbatch", name], check=True)
+            print(f"submitted {name}")
+
+
+if __name__ == "__main__":
+    main()

@@ -100,36 +100,71 @@ def train(args) -> None:
         load_state_dict=load_state,
         state_dict=lambda: {"params": state["params"], "opt_state": state["opt_state"]},
         min_replica_size=args.min_replica_size,
+        use_async_quorum=not args.diloco,  # DiLoCo requires sync quorum
         replica_id=f"llama_hsdp_{replica_id}",
         lighthouse_addr=lighthouse,
         timeout=args.timeout,
     )
 
+    diloco = None
+    if args.diloco:
+        # Semi-sync: inner adamw steps run purely in-group; every
+        # sync_every steps one fragment's pseudogradient is averaged across
+        # replica groups and applied by the outer optimizer (reference
+        # semi-sync config, examples/slurm/runner.py: sync_steps 20,
+        # 2 fragments, 1-step delay).
+        from torchft_tpu.local_sgd import DiLoCo
+
+        diloco = DiLoCo(
+            manager, state["params"],
+            outer_tx=optax.sgd(args.outer_lr, momentum=0.9, nesterov=True),
+            sync_every=args.sync_every,
+            num_fragments=args.num_fragments,
+            fragment_sync_delay=args.fragment_sync_delay,
+            should_quantize=args.quantize,
+        )
+
     rng = np.random.RandomState(replica_id)
     B, S = args.batch_size, args.seq_len
     print(f"[replica {replica_id}] mesh fsdp={args.fsdp} sp={args.sp} tp={args.tp} "
-          f"starting at step {manager.current_step()}", flush=True)
+          f"diloco={bool(diloco)} starting at step {manager.current_step()}",
+          flush=True)
     t0, tokens_done = time.monotonic(), 0
+    inner_step = 0
     while manager.current_step() < args.steps:
         batch = jax.device_put(
             jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, S))), tok_sharding
         )
-        manager.start_quorum()
-        loss, grads = grad_step(state["params"], batch, batch)
-        reduced = manager.allreduce(grads).get_future().wait(timeout=args.timeout)
-        if manager.should_commit():
+        if diloco is not None:
+            # inner step: local grads + local adamw, no cross-group traffic
+            loss, grads = grad_step(state["params"], batch, batch)
+            state["params"], state["opt_state"] = update_step(
+                state["params"], state["opt_state"], grads
+            )
+            state["params"] = diloco.step(state["params"])
+            inner_step += 1
+            tokens_done += B * S
+        else:
+            manager.start_quorum()
+            loss, grads = grad_step(state["params"], batch, batch)
+            reduced = manager.allreduce(grads).get_future().wait(
+                timeout=args.timeout
+            )
+            if not manager.should_commit():
+                continue
             state["params"], state["opt_state"] = update_step(
                 state["params"], state["opt_state"], reduced
             )
             tokens_done += B * S * manager.num_participants()
-            if manager.current_step() % args.log_every == 0:
-                dt = time.monotonic() - t0
-                print(
-                    f"[replica {replica_id}] step={manager.current_step()} "
-                    f"loss={float(loss):.4f} participants={manager.num_participants()} "
-                    f"tok/s={tokens_done / max(dt, 1e-6):.0f}",
-                    flush=True,
-                )
+        if manager.current_step() % args.log_every == 0:
+            dt = time.monotonic() - t0
+            print(
+                f"[replica {replica_id}] step={manager.current_step()} "
+                f"inner={inner_step} loss={float(loss):.4f} "
+                f"participants={manager.num_participants()} "
+                f"tok/s={tokens_done / max(dt, 1e-6):.0f}",
+                flush=True,
+            )
     manager.shutdown(wait=False)
     print(f"[replica {replica_id}] done", flush=True)
 
@@ -186,6 +221,15 @@ if __name__ == "__main__":
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--diloco", action="store_true",
+                        help="semi-sync across groups (DiLoCo) instead of "
+                             "per-step gradient allreduce")
+    parser.add_argument("--sync-every", type=int, default=20)
+    parser.add_argument("--num-fragments", type=int, default=2)
+    parser.add_argument("--fragment-sync-delay", type=int, default=1)
+    parser.add_argument("--outer-lr", type=float, default=0.7)
+    parser.add_argument("--quantize", action="store_true",
+                        help="fp8-compress the pseudogradient allreduce")
     parser.add_argument("--log-every", type=int, default=1)
     parser.add_argument("--replica-id", type=int, default=0)
     parser.add_argument("--lighthouse", type=str, default="127.0.0.1:29510")

@@ -76,7 +76,7 @@ def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
                 # snapshot: a live numpy leaf may be mutated in place by the
                 # training loop while the serving window is open — streaming
                 # an alias would tear the checkpoint mid-leaf
-                host = np.array(leaf, copy=True)
+                host = np.array(leaf, copy=True, order="C")
             else:
                 # jax.Array: np.asarray materializes a fresh host buffer
                 # (one D2H, no alias back to trainer state) — zero extra copy

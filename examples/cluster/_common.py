@@ -1,0 +1,48 @@
+"""Shared CLI surface for the cluster launch paths (GKE + slurm).
+
+One definition of the training/fault-tolerance knobs and of the reference
+DiLoCo semi-sync config (torchft/examples/slurm/runner.py:23-60: sync_steps
+20, 2 fragments, 1-step delay) so the two runners cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+# the reference semi-sync config — same Llama trainer, DiLoCo mode
+DILOCO_TRAINER_FLAGS = [
+    "--diloco",
+    "--sync-every=20",
+    "--num-fragments=2",
+    "--fragment-sync-delay=1",
+]
+
+
+def add_training_args(p: argparse.ArgumentParser) -> None:
+    """Args shared verbatim by every launch path."""
+    p.add_argument("--replica-groups", type=int, default=4)
+    p.add_argument("--min-replicas", type=int, default=2)
+    p.add_argument("--model-config", default="llama3_8b")
+    p.add_argument("--local-batch-size", type=int, default=2)
+    p.add_argument("--steps", type=int, default=10000)
+    p.add_argument("--semi-sync-method", choices=["none", "diloco"],
+                   default="none")
+    p.add_argument("--sp", type=int, default=1,
+                   help="in-group sequence-parallel degree")
+    p.add_argument("--tp", type=int, default=1,
+                   help="in-group tensor-parallel degree")
+
+
+def mesh_args(args: argparse.Namespace, chips: int) -> "tuple[int, int, int]":
+    """Resolve the in-group mesh, defaulting fsdp to fill the group's chips
+    (the trainer's own default of 1x1x1 would leave all but one chip idle).
+
+    Raises ValueError when fsdp*sp*tp does not cover ``chips``.
+    """
+    fsdp = args.fsdp if args.fsdp else max(1, chips // (args.sp * args.tp))
+    if fsdp * args.sp * args.tp != chips:
+        raise ValueError(
+            f"mesh fsdp({fsdp})*sp({args.sp})*tp({args.tp}) must equal the "
+            f"group's chip count ({chips})"
+        )
+    return fsdp, args.sp, args.tp

@@ -32,8 +32,16 @@ local_batch_size 2, steps 10000, optional DiLoCo semi-sync
 from __future__ import annotations
 
 import argparse
+import os
 import subprocess
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import (  # noqa: E402
+    DILOCO_TRAINER_FLAGS,
+    add_training_args,
+    mesh_args,
+)
 
 LIGHTHOUSE_PORT = 29510
 
@@ -126,15 +134,25 @@ def build_manifests(args: argparse.Namespace) -> str:
         )
     ]
     train_script = "examples/train_llama_hsdp.py"
+    fsdp, sp, tp = mesh_args(args, args.chips_per_slice)
+    topo_chips = 1
+    for d in args.tpu_topology.split("x"):
+        topo_chips *= int(d)
+    if topo_chips != args.chips_per_slice:
+        raise ValueError(
+            f"--tpu-topology {args.tpu_topology} has {topo_chips} chips but "
+            f"--chips-per-slice is {args.chips_per_slice}; GKE only schedules "
+            "pods whose google.com/tpu request matches the slice"
+        )
     extra = '\n        - "--config={0}"'.format(args.model_config)
+    extra += (
+        f'\n        - "--fsdp={fsdp}"'
+        f'\n        - "--sp={sp}"'
+        f'\n        - "--tp={tp}"'
+    )
     if args.semi_sync_method == "diloco":
-        # reference semi-sync config: sync_steps 20, 2 fragments, 1-step
-        # delay — same Llama-3-8B trainer, DiLoCo mode
-        extra += (
-            '\n        - "--diloco"'
-            '\n        - "--sync-every=20"'
-            '\n        - "--num-fragments=2"'
-            '\n        - "--fragment-sync-delay=1"'
+        extra += "".join(
+            f'\n        - "{flag}"' for flag in DILOCO_TRAINER_FLAGS
         )
     for rid in range(args.replica_groups):
         docs.append(
@@ -157,19 +175,21 @@ def build_manifests(args: argparse.Namespace) -> str:
 
 def main(argv: "list[str] | None" = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--replica-groups", type=int, default=4)
-    p.add_argument("--min-replicas", type=int, default=2)
+    add_training_args(p)
     p.add_argument("--image", default="gcr.io/PROJECT/torchft-tpu:latest")
     p.add_argument("--tpu-type", default="tpu-v5p-slice")
-    p.add_argument("--tpu-topology", default="2x2x4",
-                   help="per-replica-group slice topology (v5p-64 = 2x2x4 x4 chips)")
+    # defaults must agree: GKE TPU scheduling requires the google.com/tpu
+    # request to match the selected topology's chip count (2x2x1 = 4 chips)
+    p.add_argument("--tpu-topology", default="2x2x1",
+                   help="per-replica-group slice topology; its chip count "
+                        "must equal --chips-per-slice (v5p 2x2x1 = 4). "
+                        "Single-host topologies only: the generated Job is "
+                        "one pod per group (GROUP_WORLD_SIZE=1); multi-host "
+                        "slices need an indexed Job with per-host pods")
     p.add_argument("--chips-per-slice", type=int, default=4,
-                   help="TPU chips requested per pod")
-    p.add_argument("--model-config", default="llama3_8b")
-    p.add_argument("--local-batch-size", type=int, default=2)
-    p.add_argument("--steps", type=int, default=10000)
-    p.add_argument("--semi-sync-method", choices=["none", "diloco"],
-                   default="none")
+                   help="TPU chips requested per pod (= topology chip count)")
+    p.add_argument("--fsdp", type=int, default=0,
+                   help="in-group ZeRO shard degree (0 = fill the slice)")
     p.add_argument("--out", default="-", help="output file ('-' = stdout)")
     p.add_argument("--apply", action="store_true",
                    help="kubectl apply the generated manifests")

@@ -15,15 +15,24 @@ submitting, so the launch path is reviewable without a cluster:
 from __future__ import annotations
 
 import argparse
-import shlex
+import os
 import subprocess
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import (  # noqa: E402
+    DILOCO_TRAINER_FLAGS,
+    add_training_args,
+    mesh_args,
+)
 
 LIGHTHOUSE_SBATCH = """\
 #!/bin/bash
 #SBATCH --job-name=torchft-lighthouse
 #SBATCH --nodes=1
+#SBATCH --nodelist={lighthouse_host}
 #SBATCH --output=lighthouse.log
+#SBATCH --requeue
 exec python -m torchft_tpu.lighthouse \\
     --bind=0.0.0.0:{port} --min-replicas={min_replicas} \\
     --join-timeout-ms=60000 --quorum-tick-ms=100 --heartbeat-timeout-ms=5000
@@ -41,7 +50,8 @@ export NUM_REPLICA_GROUPS={num_groups}
 export GROUP_RANK=0
 export GROUP_WORLD_SIZE=1
 exec python {train_script} \\
-    {config_arg}--batch-size={local_batch_size} --steps={steps}{extra}
+    {config_arg}--batch-size={local_batch_size} --steps={steps} \\
+    --fsdp={fsdp} --sp={sp} --tp={tp}{extra}
 """
 
 
@@ -50,17 +60,24 @@ def build_scripts(args: argparse.Namespace) -> "list[tuple[str, str]]":
         (
             "lighthouse.sbatch",
             LIGHTHOUSE_SBATCH.format(
-                port=args.port, min_replicas=args.min_replicas
+                # pin to the host every replica's TORCHFT_LIGHTHOUSE points
+                # at; otherwise slurm may place the lighthouse elsewhere
+                lighthouse_host=args.lighthouse_host,
+                port=args.port,
+                min_replicas=args.min_replicas,
             ),
         )
     ]
-    train_script = "examples/train_llama_hsdp.py"
+    # absolute path: sbatch scripts start in the submission cwd, which is
+    # rarely the repo root
+    train_script = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "train_llama_hsdp.py")
+    )
     config_arg = f"--config={args.model_config} "
+    fsdp, sp, tp = mesh_args(args, args.chips_per_node)
     extra = ""
     if args.semi_sync_method == "diloco":
-        # same Llama trainer, semi-sync mode (reference config)
-        extra = (" \\\n    --diloco --sync-every=20 --num-fragments=2"
-                 " --fragment-sync-delay=1")
+        extra = " \\\n    " + " ".join(DILOCO_TRAINER_FLAGS)
     for rid in range(args.replica_groups):
         scripts.append(
             (
@@ -74,6 +91,9 @@ def build_scripts(args: argparse.Namespace) -> "list[tuple[str, str]]":
                     config_arg=config_arg,
                     local_batch_size=args.local_batch_size,
                     steps=args.steps,
+                    fsdp=fsdp,
+                    sp=sp,
+                    tp=tp,
                     extra=extra,
                 ),
             )
@@ -83,8 +103,7 @@ def build_scripts(args: argparse.Namespace) -> "list[tuple[str, str]]":
 
 def main(argv: "list[str] | None" = None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--replica-groups", type=int, default=4)
-    p.add_argument("--min-replicas", type=int, default=2)
+    add_training_args(p)
     p.add_argument(
         "--lighthouse-host", default=None,
         help="hostname running the lighthouse job (REQUIRED to submit: each "
@@ -92,11 +111,10 @@ def main(argv: "list[str] | None" = None) -> None:
              "can discover the lighthouse's node)",
     )
     p.add_argument("--port", type=int, default=29510)
-    p.add_argument("--model-config", default="llama3_8b")
-    p.add_argument("--local-batch-size", type=int, default=2)
-    p.add_argument("--steps", type=int, default=10000)
-    p.add_argument("--semi-sync-method", choices=["none", "diloco"],
-                   default="none")
+    p.add_argument("--chips-per-node", type=int, default=4,
+                   help="TPU chips per TPU-VM node (the in-group mesh)")
+    p.add_argument("--fsdp", type=int, default=0,
+                   help="in-group ZeRO shard degree (0 = fill the node)")
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
     if args.lighthouse_host is None:

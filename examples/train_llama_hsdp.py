@@ -122,6 +122,9 @@ def train(args) -> None:
             num_fragments=args.num_fragments,
             fragment_sync_delay=args.fragment_sync_delay,
             should_quantize=args.quantize,
+            # after a live heal the quorum rebinds state["params"]; this
+            # lets DiLoCo re-read them instead of using stale leaves
+            get_params=lambda: state["params"],
         )
 
     rng = np.random.RandomState(replica_id)
@@ -130,8 +133,24 @@ def train(args) -> None:
           f"diloco={bool(diloco)} starting at step {manager.current_step()}",
           flush=True)
     t0, tokens_done = time.monotonic(), 0
+    # --steps counts inner optimizer steps in both modes. manager.current_step
+    # only advances on committed quorums — in DiLoCo mode that is one per
+    # sync_every/num_fragments inner steps, so gating the loop on it would
+    # run sync_every/num_fragments times more compute than asked for. A
+    # restarted replica learns the global step only at its first quorum
+    # (inside diloco.step), so the inner count is re-clamped to the global
+    # progress after every boundary rather than once up front.
     inner_step = 0
-    while manager.current_step() < args.steps:
+    if diloco is not None:
+        # the authoritative per-fragment cycle length: DiLoCo recomputes the
+        # fragment count from the actual partition, so re-deriving it from
+        # the CLI args could disagree with the real quorum cadence
+        per_cycle = diloco._sync_every
+        done = lambda: inner_step >= args.steps  # noqa: E731
+    else:
+        per_cycle = 0  # unused
+        done = lambda: manager.current_step() >= args.steps  # noqa: E731
+    while not done():
         batch = jax.device_put(
             jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, S))), tok_sharding
         )
@@ -141,8 +160,12 @@ def train(args) -> None:
             state["params"], state["opt_state"] = update_step(
                 state["params"], state["opt_state"], grads
             )
+            # on a heal, diloco.step re-reads state["params"] via get_params
+            # and returns the healed pytree
             state["params"] = diloco.step(state["params"])
-            inner_step += 1
+            # resume/catch-up: committed quorums are the global clock
+            inner_step = max(inner_step + 1,
+                             manager.current_step() * per_cycle)
             tokens_done += B * S
         else:
             manager.start_quorum()
@@ -156,7 +179,11 @@ def train(args) -> None:
                 state["params"], state["opt_state"], reduced
             )
             tokens_done += B * S * manager.num_participants()
-        if manager.current_step() % args.log_every == 0:
+            inner_step += 1
+        # gate on the count that actually advances every loop iteration:
+        # in DiLoCo mode manager.current_step is constant across a whole
+        # inner window (bursty/silent logs); inner_step is not
+        if inner_step % args.log_every == 0:
             dt = time.monotonic() - t0
             print(
                 f"[replica {replica_id}] step={manager.current_step()} "

@@ -134,7 +134,8 @@ class TestClusterRunners:
         args = argparse.Namespace(
             replica_groups=3, min_replicas=2,
             image="img:latest", tpu_type="tpu-v5p-slice",
-            tpu_topology="2x2x4", chips_per_slice=4,
+            tpu_topology="2x2x1", chips_per_slice=4,
+            fsdp=0, sp=1, tp=1,
             model_config="llama3_8b", local_batch_size=2, steps=10000,
             semi_sync_method="none",
         )
@@ -160,7 +161,8 @@ class TestClusterRunners:
         args = argparse.Namespace(
             replica_groups=2, min_replicas=1,
             image="img", tpu_type="t", tpu_topology="2x2",
-            chips_per_slice=4, model_config="llama3_8b",
+            chips_per_slice=4, fsdp=0, sp=1, tp=1,
+            model_config="llama3_8b",
             local_batch_size=2, steps=100, semi_sync_method="diloco",
         )
         text = mod.build_manifests(args)
@@ -176,6 +178,7 @@ class TestClusterRunners:
         args = argparse.Namespace(
             replica_groups=2, min_replicas=2, lighthouse_host="lh-host",
             port=29510, model_config="llama3_8b", local_batch_size=2,
+            chips_per_node=4, fsdp=0, sp=1, tp=1,
             steps=10000, semi_sync_method="none",
         )
         scripts = dict(mod.build_scripts(args))

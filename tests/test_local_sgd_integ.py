@@ -96,6 +96,7 @@ class TestLocalSGDInteg:
                 diloco = DiLoCo(
                     manager, state["params"],
                     outer_tx=optax.sgd(1.0), sync_every=SYNC_EVERY,
+                    get_params=lambda: state["params"],
                 )
                 for i in range(STEPS):
                     # different inner drift per replica
@@ -108,10 +109,13 @@ class TestLocalSGDInteg:
                 manager.shutdown(wait=False)
 
         results = run_threads([lambda r=r: replica(r) for r in range(2)])
-        # outer lr=1, avg pseudograd per cycle = 0.1*2*(1+2)/2/2 = 0.3/2... :
-        # replica drift per cycle: r0 -0.2, r1 -0.4 -> pseudograds 0.2, 0.4
-        # avg 0.3 -> global -= 0.3 per cycle; 4 cycles -> -1.2
-        np.testing.assert_allclose(results[0], [-1.2], rtol=1e-5)
+        # Cycle 1 includes the init_sync live heal: r1 recovers r0's state
+        # mid-cycle (params=-0.2, fragment global=0), discarding r1's own
+        # drift — its pseudograd becomes a copy of r0's (0.2), so
+        # cycle-1 avg = 0.2, global -> -0.2. Cycles 2-4 are steady state:
+        # drifts 0.2/0.4 -> avg pseudograd 0.3 per cycle. Final:
+        # -(0.2 + 3*0.3) = -1.1.
+        np.testing.assert_allclose(results[0], [-1.1], rtol=1e-5)
         np.testing.assert_array_equal(results[0], results[1])
 
     def test_diloco_recovery_after_crash(self, lighthouse):

@@ -173,6 +173,14 @@ class TestHealing:
         load_fn.assert_called_once()
         assert m.current_step() == 2
         assert m.is_participating()  # sync mode participates after heal
+        # functional loops re-read rebound state through this signal
+        assert m.last_quorum_healed()
+
+    def test_last_quorum_healed_resets_on_healthy_quorum(self):
+        m = make_manager(quorum=make_quorum(), min_replica_size=1,
+                         use_async_quorum=False)
+        m.start_quorum()
+        assert not m.last_quorum_healed()
 
     def test_send_checkpoint_to_recovering_peers(self):
         q = make_quorum(recover_dst_replica_ranks=[1])

@@ -40,22 +40,38 @@ logger = logging.getLogger(__name__)
 __all__ = ["LocalSGD", "DiLoCo", "partition_fragments"]
 
 
-def _to_host(tree: Any) -> Any:
+def _snapshot(tree: Any) -> Any:
+    """Rollback copy of a pytree. jax.Arrays are immutable — holding the
+    reference IS the snapshot; only mutable numpy leaves need a real copy."""
     import jax
 
-    return jax.tree_util.tree_map(lambda x: np.array(x, copy=True), tree)
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, jax.Array) else np.array(x, copy=True),
+        tree,
+    )
 
 
-def _like(template: Any, host_tree: Any) -> Any:
-    """Place host arrays back like the template leaves (device + sharding)."""
+def _like(template: Any, values: Any) -> Any:
+    """Place values back like the template leaves (device + sharding).
+    Device-resident values take the zero-copy `device_put` path; host arrays
+    are uploaded."""
     import jax
 
     def place(t, h):
         if isinstance(t, jax.Array):
+            if isinstance(h, jax.Array) and h.dtype == t.dtype:
+                return jax.device_put(h, t.sharding)
             return jax.device_put(np.asarray(h, dtype=t.dtype), t.sharding)
         return np.asarray(h)
 
-    return jax.tree_util.tree_map(place, template, host_tree)
+    return jax.tree_util.tree_map(place, template, values)
+
+
+def _nbytes(leaf: Any) -> int:
+    """Leaf size in bytes without forcing a device→host transfer."""
+    if hasattr(leaf, "nbytes"):
+        return int(leaf.nbytes)
+    return int(np.asarray(leaf).nbytes)
 
 
 class LocalSGD:
@@ -69,12 +85,25 @@ class LocalSGD:
             params = local_sgd.step(params)
     """
 
-    def __init__(self, manager: Manager, params: Any, sync_every: int) -> None:
+    def __init__(
+        self,
+        manager: Manager,
+        params: Any,
+        sync_every: int,
+        get_params: Optional[Any] = None,
+    ) -> None:
         assert sync_every >= 1
         self._manager = manager
         self._sync_every = sync_every
         self._local_step = 0
-        self._backup = _to_host(params)
+        # get_params only matters for sync-quorum managers: with async quorum
+        # a healing replica is non-participating, so Manager.allreduce zeros
+        # its contribution and the averaged result it adopts is built from
+        # healthy peers only — no staleness can leak into the group. On a
+        # sync-quorum heal without get_params, _sync falls back to averaging
+        # the healed backup.
+        self._get_params = get_params
+        self._backup = _snapshot(params)
         manager.register_state_dict_fn(
             "LocalSGD",
             self._load_state,
@@ -99,10 +128,24 @@ class LocalSGD:
         # deadlock against the checkpoint server's read lock (the reference
         # locks only around in-place optimizer mutation, local_sgd.py:111-123).
         self._manager.start_quorum()
+        if self._manager.last_quorum_healed():
+            # a sync-quorum heal rebound the caller's state; the `params`
+            # captured before start_quorum are stale and must not be
+            # averaged into the group
+            if self._get_params is not None:
+                params = self._get_params()
+            else:
+                # fallback: our own registered load fn just healed the
+                # backup (the peer's last synced params) — average that
+                logger.warning(
+                    "LocalSGD: healed without get_params; averaging the "
+                    "recovered backup instead of the stale local params"
+                )
+                params = _like(params, self._backup)
         work = self._manager.allreduce(params, reduce_op=ReduceOp.AVG)
         averaged = work.get_future().wait()
         if self._manager.should_commit():
-            self._backup = _to_host(averaged)
+            self._backup = _snapshot(averaged)
             return _like(params, averaged)
         logger.warning("LocalSGD commit failed; restoring last synced params")
         return _like(params, self._backup)
@@ -117,7 +160,7 @@ def partition_fragments(leaves: Sequence[Any], num_fragments: int) -> List[List[
     """
     from torchft_tpu.checkpointing._serialization import split_chunks
 
-    sizes = [int(np.asarray(l).nbytes) for l in leaves]
+    sizes = [_nbytes(l) for l in leaves]
     frags = [sorted(c) for c in split_chunks(sizes, num_fragments)]
     return [f for f in frags if f]
 
@@ -126,16 +169,15 @@ def partition_fragments(leaves: Sequence[Any], num_fragments: int) -> List[List[
 DEFAULT_BUCKET_CAP_BYTES = 1 << 30
 
 
-def _make_buckets(
-    arrays: List[np.ndarray], cap_bytes: int
-) -> List[tuple]:
+def _make_buckets(arrays: List[Any], cap_bytes: int) -> List[tuple]:
     """Pack arrays into flat same-dtype buckets of at most ``cap_bytes``.
 
     Returns ``[(flat_buffer, metas), ...]`` with ``metas = [(arr_index,
     offset, size, shape), ...]``. Fewer, larger collectives amortize the
     per-op framing/pickling overhead of the host DCN plane — the same
     motivation as the reference's bucketized allreduce (local_sgd.py:498-566),
-    minus the NCCL-launch angle which does not exist on TPU.
+    minus the NCCL-launch angle which does not exist on TPU. jax.Array inputs
+    are packed on device (one fused concatenate, no host round-trip).
     """
     by_dtype: Dict[Any, List[int]] = {}
     for i, a in enumerate(arrays):
@@ -146,7 +188,7 @@ def _make_buckets(
         cur: List[int] = []
         cur_bytes = 0
         for i in idxs:
-            nbytes = arrays[i].nbytes
+            nbytes = _nbytes(arrays[i])
             if cur and cur_bytes + nbytes > cap_bytes:
                 groups.append(cur)
                 cur, cur_bytes = [], 0
@@ -157,23 +199,35 @@ def _make_buckets(
     return [_pack_bucket(arrays, g) for g in groups]
 
 
-def _pack_bucket(arrays: List[np.ndarray], idxs: List[int]) -> tuple:
+def _pack_bucket(arrays: List[Any], idxs: List[int]) -> tuple:
+    import jax
+
     metas = []
     offset = 0
     for i in idxs:
         a = arrays[i]
         metas.append((i, offset, a.size, a.shape))
         offset += a.size
-    flat = np.empty(offset, dtype=arrays[idxs[0]].dtype)
-    for (i, off, size, _shape) in metas:
-        flat[off : off + size] = arrays[i].reshape(-1)
+    if all(isinstance(arrays[i], jax.Array) for i in idxs):
+        import jax.numpy as jnp
+
+        flat = jnp.concatenate([arrays[i].reshape(-1) for i in idxs])
+    else:
+        flat = np.empty(offset, dtype=arrays[idxs[0]].dtype)
+        for (i, off, size, _shape) in metas:
+            flat[off : off + size] = np.asarray(arrays[i]).reshape(-1)
     return flat, metas
 
 
-def _unpack_buckets(buckets_out: List[np.ndarray], bucket_metas: List[List[tuple]], n: int) -> List[np.ndarray]:
-    out: List[Optional[np.ndarray]] = [None] * n
+def _unpack_buckets(
+    buckets_out: List[Any], bucket_metas: List[List[tuple]], n: int
+) -> List[Any]:
+    import jax
+
+    out: List[Optional[Any]] = [None] * n
     for flat, metas in zip(buckets_out, bucket_metas):
-        flat = np.asarray(flat)
+        if not isinstance(flat, jax.Array):
+            flat = np.asarray(flat)
         for (i, off, size, shape) in metas:
             out[i] = flat[off : off + size].reshape(shape)
     assert all(o is not None for o in out)
@@ -182,7 +236,20 @@ def _unpack_buckets(buckets_out: List[np.ndarray], bucket_metas: List[List[tuple
 
 class _Fragment:
     """One fragment's state: global (backup) params + outer optimizer state +
-    in-flight allreduce (reference _StreamingDiLoCoFragment)."""
+    in-flight allreduce (reference _StreamingDiLoCoFragment).
+
+    Two execution modes, picked per fragment from the leaf types:
+
+    - **device** (all leaves are jax.Arrays — the production path): global
+      params and outer optimizer state stay device-resident with the leaves'
+      shardings, and pseudogradient / outer step / merge run as jitted
+      functions. Nothing crosses to the host except whatever the configured
+      data plane itself ships (nothing for ProcessGroupXLA; fp8 payloads for
+      the quantized path; raw frames for the host plane). The reference's
+      equivalent is its GPU-resident backup option (local_sgd.py:241-253).
+    - **host** (numpy leaves — tests, CPU-plane experiments): numpy backups
+      and a numpy outer step, as before.
+    """
 
     def __init__(
         self,
@@ -196,6 +263,7 @@ class _Fragment:
         use_bucketization: bool = False,
         bucket_cap_bytes: int = DEFAULT_BUCKET_CAP_BYTES,
     ) -> None:
+        import jax
         import optax  # noqa: F401  (typing only)
 
         self._manager = manager
@@ -208,12 +276,41 @@ class _Fragment:
         self._bucket_cap_bytes = bucket_cap_bytes
         self._bucket_metas: Optional[List[List[tuple]]] = None
 
-        # global ("original") parameters live on host, like the reference's
-        # CPU backups (local_sgd.py:241-253)
-        self.original: List[np.ndarray] = [np.array(leaves[i], copy=True) for i in leaf_indices]
+        self._on_device = all(
+            isinstance(leaves[i], jax.Array) for i in leaf_indices
+        )
+        if self._on_device:
+            # jax.Arrays are immutable: the reference IS the backup
+            self.original: List[Any] = [leaves[i] for i in leaf_indices]
+        else:
+            # host mode mirrors the reference's CPU backups
+            # (local_sgd.py:241-253)
+            self.original = [
+                np.array(leaves[i], copy=True) for i in leaf_indices
+            ]
         self.outer_state = outer_tx.init(self.original)
         self._work: Optional[Work] = None
         self._pending_grads: Optional[List[np.ndarray]] = None
+
+        if self._on_device:
+            alpha = self._alpha
+
+            def _pseudograd(original, local):
+                return [
+                    (o - l).astype(o.dtype) for o, l in zip(original, local)
+                ]
+
+            def _outer_step(grads, state, original, local):
+                updates, new_state = outer_tx.update(grads, state, original)
+                new_global = optax.apply_updates(original, updates)
+                merged = [
+                    (g + alpha * (l - g)).astype(g.dtype)
+                    for g, l in zip(new_global, local)
+                ]
+                return new_global, new_state, merged
+
+            self._pseudograd_jit = jax.jit(_pseudograd)
+            self._outer_step_jit = jax.jit(_outer_step)
 
         manager.register_state_dict_fn(
             f"StreamingDiLoCoFragment_{fragment_id}",
@@ -223,22 +320,46 @@ class _Fragment:
 
     def _save_state(self) -> Dict[str, Any]:
         return {
-            "original_parameters": [p.copy() for p in self.original],
+            "original_parameters": [
+                p if self._on_device else p.copy() for p in self.original
+            ],
             "outer_optimizer": self.outer_state,
         }
 
     def _load_state(self, sd: Dict[str, Any]) -> None:
-        self.original = [np.asarray(p) for p in sd["original_parameters"]]
-        self.outer_state = sd["outer_optimizer"]
+        import jax
+
+        incoming = list(sd["original_parameters"])
+        if self._on_device:
+            # recovered state may arrive as host arrays (HTTP transport);
+            # restore it to the fragment's device placement
+            self.original = [
+                _like(t, p) for t, p in zip(self.original, incoming)
+            ]
+            self.outer_state = jax.tree_util.tree_map(
+                lambda t, p: _like(t, p) if isinstance(t, jax.Array) else p,
+                self.outer_state,
+                sd["outer_optimizer"],
+            )
+        else:
+            self.original = [np.asarray(p) for p in incoming]
+            self.outer_state = sd["outer_optimizer"]
 
     # -- sync phases ------------------------------------------------------
     def prepare_sync(self, leaves: List[Any]) -> None:
         """Pseudogradient = global - local, issue async averaged allreduce
         (reference: local_sgd.py:401-420)."""
-        pseudograds = [
-            (self.original[k] - np.asarray(leaves[i])).astype(self.original[k].dtype)
-            for k, i in enumerate(self.leaf_indices)
-        ]
+        if self._on_device:
+            pseudograds = self._pseudograd_jit(
+                self.original, [leaves[i] for i in self.leaf_indices]
+            )
+        else:
+            pseudograds = [
+                (self.original[k] - np.asarray(leaves[i])).astype(
+                    self.original[k].dtype
+                )
+                for k, i in enumerate(self.leaf_indices)
+            ]
         assert self._work is None, "fragment already has an allreduce in flight"
         # Quantized allreduce already concatenates everything into one flat
         # wire buffer (collectives.py), so pre-bucketing there would add a
@@ -277,23 +398,35 @@ class _Fragment:
             self._bucket_metas = None
 
         # save local, restore global (rollback point)
-        local = [np.array(leaves[i], copy=True) for i in self.leaf_indices]
+        if self._on_device:
+            local = [leaves[i] for i in self.leaf_indices]  # immutable
+        else:
+            local = [np.array(leaves[i], copy=True) for i in self.leaf_indices]
         restored = list(self.original)
 
         should_commit = self._manager.should_commit()
         if should_commit:
-            grads = [np.asarray(g) for g in avg_pseudograds]
-            updates, self.outer_state = self._outer_tx.update(
-                grads, self.outer_state, restored
-            )
-            new_global = optax.apply_updates(restored, updates)
-            new_global = [np.asarray(p) for p in new_global]
-            self.original = [p.copy() for p in new_global]
-            # merge: global.lerp(local, alpha)
-            merged = [
-                (g + self._alpha * (l - g)).astype(g.dtype)
-                for g, l in zip(new_global, local)
-            ]
+            if self._on_device:
+                grads = [
+                    _like(t, g) for t, g in zip(restored, avg_pseudograds)
+                ]
+                new_global, self.outer_state, merged = self._outer_step_jit(
+                    grads, self.outer_state, restored, local
+                )
+                self.original = list(new_global)
+            else:
+                grads = [np.asarray(g) for g in avg_pseudograds]
+                updates, self.outer_state = self._outer_tx.update(
+                    grads, self.outer_state, restored
+                )
+                new_global = optax.apply_updates(restored, updates)
+                new_global = [np.asarray(p) for p in new_global]
+                self.original = [p.copy() for p in new_global]
+                # merge: global.lerp(local, alpha)
+                merged = [
+                    (g + self._alpha * (l - g)).astype(g.dtype)
+                    for g, l in zip(new_global, local)
+                ]
             for k, i in enumerate(self.leaf_indices):
                 leaves[i] = merged[k]
         else:
@@ -301,7 +434,9 @@ class _Fragment:
                 f"DiLoCo fragment {self._id}: commit failed; restoring global params"
             )
             for k, i in enumerate(self.leaf_indices):
-                leaves[i] = restored[k].copy()
+                leaves[i] = (
+                    restored[k] if self._on_device else restored[k].copy()
+                )
         return should_commit
 
 
@@ -330,6 +465,7 @@ class DiLoCo:
         should_quantize: bool = False,
         use_bucketization: Optional[bool] = None,
         bucket_cap_mb: Optional[int] = None,
+        get_params: Optional[Any] = None,
     ) -> None:
         import jax
 
@@ -370,6 +506,13 @@ class DiLoCo:
         self._manager = manager
         self._local_step = 0
         self._delay = fragment_sync_delay
+        # functional heal hook: after a sync-quorum live recovery the user's
+        # param pytree is rebound by their registered load fn, so leaves
+        # captured before start_quorum are stale. get_params() re-reads the
+        # authoritative (healed) pytree. The reference never faces this —
+        # torch heals nn.Module tensors in place (manager.py:819-846) and
+        # the module reference stays valid.
+        self._get_params = get_params
         self._fragments = [
             _Fragment(
                 manager, i, idxs, leaves, outer_tx,
@@ -393,11 +536,45 @@ class DiLoCo:
         self._local_step += 1
 
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        changed = False
+        healed_fallback_indices: List[int] = []
 
         if self._local_step == self._sync_every - self._delay:
             # prepare: overlap the allreduce with the next `delay` steps
             self._manager.start_quorum()
+            if self._manager.last_quorum_healed():
+                # The quorum live-healed this replica: fragment globals and
+                # the user's params were rebound by the registered load fns,
+                # so the leaves flattened from the pre-heal pytree are stale —
+                # pseudogradients from them would be garbage AVERAGED INTO
+                # EVERY replica group.
+                if self._get_params is not None:
+                    # re-read the healed pytree: pseudograd = original -
+                    # healed_local, the reference's semantics (its in-place
+                    # module heal makes this automatic)
+                    params = self._get_params()
+                    leaves, treedef = jax.tree_util.tree_flatten(params)
+                else:
+                    # safe fallback: treat the healed replica as having no
+                    # local drift (local := healed original → zero
+                    # pseudograd). Conservative but never corrupting.
+                    logger.warning(
+                        "DiLoCo: healed without get_params; contributing "
+                        "zero pseudogradient this cycle (pass get_params "
+                        "for full-fidelity post-heal syncs)"
+                    )
+                    for frag_ in self._fragments:
+                        for k, i in enumerate(frag_.leaf_indices):
+                            # copy on the host path: numpy callers may
+                            # mutate params in place, which must not reach
+                            # the fragment's rollback backup
+                            leaves[i] = (
+                                frag_.original[k]
+                                if frag_._on_device
+                                else frag_.original[k].copy()
+                            )
+                            # must survive into the returned pytree even
+                            # when this boundary performs no sync (delay>0)
+                            healed_fallback_indices.append(i)
             frag = self._current_fragment()
             logger.info(f"DiLoCo: preparing fragment={frag} step={self._local_step}")
             self._fragments[frag].prepare_sync(leaves)
@@ -412,6 +589,9 @@ class DiLoCo:
             changed_indices = self._fragments[frag].leaf_indices
             self._local_step = 0
 
+        changed_indices = sorted(
+            set(changed_indices) | set(healed_fallback_indices)
+        )
         if not changed_indices:
             return params
         # Re-place only the synced fragment's leaves; the other fragments'
@@ -421,9 +601,9 @@ class DiLoCo:
         for i in changed_indices:
             orig = orig_leaves[i]
             if isinstance(orig, jax.Array):
-                leaves[i] = jax.device_put(
-                    np.asarray(leaves[i], dtype=orig.dtype), orig.sharding
-                )
+                # device-path leaves are already jax.Arrays — _like is a
+                # zero-copy device_put to the original sharding
+                leaves[i] = _like(orig, leaves[i])
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # introspection used by tests

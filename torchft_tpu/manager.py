@@ -246,6 +246,7 @@ class Manager:
         self._commit_failures = 0
         self._errored: Optional[ExceptionWithTraceback] = None
         self._healing = False
+        self._last_quorum_healed = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._participating_replica_rank: Optional[int] = None
         self._participating_replica_world_size: int = 0
@@ -308,6 +309,7 @@ class Manager:
 
         self._errored = None
         self._healing = False
+        self._last_quorum_healed = False
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -468,6 +470,7 @@ class Manager:
                 if key in user:
                     load_fn(user[key])
             self._pending_state_dict = None
+        self._last_quorum_healed = True
 
     # ------------------------------------------------------------ allreduce
     @traced("torchft::manager::allreduce")
@@ -745,6 +748,13 @@ class Manager:
             assert self._use_async_quorum
             return False
         return True
+
+    def last_quorum_healed(self) -> bool:
+        """True iff the most recent quorum live-healed this replica (its
+        registered state-dict fns were fed recovered state). Functional
+        training loops use this to re-read state that the quorum rebound —
+        values captured before ``start_quorum`` are stale after a heal."""
+        return self._last_quorum_healed
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self, wait: bool = True) -> None:

@@ -134,16 +134,19 @@ def build_manifests(args: argparse.Namespace) -> str:
         )
     ]
     train_script = "examples/train_llama_hsdp.py"
-    fsdp, sp, tp = mesh_args(args, args.chips_per_slice)
     topo_chips = 1
     for d in args.tpu_topology.split("x"):
         topo_chips *= int(d)
-    if topo_chips != args.chips_per_slice:
+    # chips-per-slice is derived from the topology (GKE only schedules pods
+    # whose google.com/tpu request matches the slice); the flag exists only
+    # as an explicit override and must then agree
+    chips = args.chips_per_slice or topo_chips
+    if chips != topo_chips:
         raise ValueError(
             f"--tpu-topology {args.tpu_topology} has {topo_chips} chips but "
-            f"--chips-per-slice is {args.chips_per_slice}; GKE only schedules "
-            "pods whose google.com/tpu request matches the slice"
+            f"--chips-per-slice override is {args.chips_per_slice}"
         )
+    fsdp, sp, tp = mesh_args(args, chips)
     extra = '\n        - "--config={0}"'.format(args.model_config)
     extra += (
         f'\n        - "--fsdp={fsdp}"'
@@ -161,7 +164,7 @@ def build_manifests(args: argparse.Namespace) -> str:
                 image=args.image,
                 tpu_type=args.tpu_type,
                 tpu_topology=args.tpu_topology,
-                chips=args.chips_per_slice,
+                chips=chips,
                 train_script=train_script,
                 local_batch_size=args.local_batch_size,
                 steps=args.steps,
@@ -186,8 +189,9 @@ def main(argv: "list[str] | None" = None) -> None:
                         "Single-host topologies only: the generated Job is "
                         "one pod per group (GROUP_WORLD_SIZE=1); multi-host "
                         "slices need an indexed Job with per-host pods")
-    p.add_argument("--chips-per-slice", type=int, default=4,
-                   help="TPU chips requested per pod (= topology chip count)")
+    p.add_argument("--chips-per-slice", type=int, default=0,
+                   help="TPU chips requested per pod (0 = derive from the "
+                        "topology product; an override must agree with it)")
     p.add_argument("--fsdp", type=int, default=0,
                    help="in-group ZeRO shard degree (0 = fill the slice)")
     p.add_argument("--out", default="-", help="output file ('-' = stdout)")

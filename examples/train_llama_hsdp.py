@@ -86,13 +86,37 @@ def train(args) -> None:
     state = {"params": params, "opt_state": opt_state}
 
     def load_state(sd):
+        def place(t, x):
+            # mesh-sharded leaves are committed back onto their sharding;
+            # everything else (e.g. optimizer step counters, which tx.init
+            # left on the default device) stays uncommitted so jit remains
+            # free to place it — committing a scalar to one device while
+            # params commit to the mesh makes the jitted step reject the mix
+            if isinstance(t, jax.Array):
+                if isinstance(t.sharding, NamedSharding):
+                    return jax.device_put(jnp.asarray(x, dtype=t.dtype),
+                                          t.sharding)
+                # via host: restored leaves may arrive as arrays already
+                # committed to one device, and committedness survives
+                # jnp.asarray
+                return jnp.asarray(np.asarray(x), dtype=t.dtype)
+            return x
+
         state["params"] = jax.tree_util.tree_map(
-            lambda t, x: jax.device_put(jnp.asarray(x), t.sharding),
-            state["params"], sd["params"],
+            place, state["params"], sd["params"]
         )
         state["opt_state"] = jax.tree_util.tree_map(
-            lambda t, x: jnp.asarray(x) if hasattr(t, "dtype") else x,
-            state["opt_state"], sd["opt_state"],
+            place, state["opt_state"], sd["opt_state"]
+        )
+
+    # tier-2 durable checkpoints (tier 1 = live healing between replicas)
+    ckpt = None
+    if args.ckpt_dir:
+        from torchft_tpu.checkpointing import DurableCheckpointer
+
+        ckpt = DurableCheckpointer(
+            os.path.join(args.ckpt_dir, f"replica_{replica_id}"),
+            save_interval_steps=args.ckpt_every,
         )
 
     manager = Manager(
@@ -126,6 +150,20 @@ def train(args) -> None:
             # lets DiLoCo re-read them instead of using stale leaves
             get_params=lambda: state["params"],
         )
+
+    # restore AFTER every state-dict fn is registered (trainer state above,
+    # DiLoCo fragments in the constructor) so a cold restart recovers the
+    # full composite — including fragment globals and outer-optimizer
+    # momentum — not just params/opt_state; then resume the quorum clock.
+    if ckpt is not None:
+        restored = ckpt.restore(state_template=manager.user_state_dict())
+        if restored is not None:
+            user_sd, manager_sd, _ = restored
+            manager.load_user_state_dict(user_sd)
+            if manager_sd is not None:
+                manager.load_state_dict(manager_sd)
+            print(f"[replica {replica_id}] restored durable checkpoint "
+                  f"step={manager.current_step()}", flush=True)
 
     rng = np.random.RandomState(replica_id)
     B, S = args.batch_size, args.seq_len
@@ -183,6 +221,11 @@ def train(args) -> None:
         # gate on the count that actually advances every loop iteration:
         # in DiLoCo mode manager.current_step is constant across a whole
         # inner window (bursty/silent logs); inner_step is not
+        if ckpt is not None:
+            # lazy: the full registered composite (trainer + algorithm
+            # state) is only materialized on the save interval
+            ckpt.maybe_save(manager.current_step(), manager.user_state_dict,
+                            manager=manager)
         if inner_step % args.log_every == 0:
             dt = time.monotonic() - t0
             print(
@@ -192,6 +235,8 @@ def train(args) -> None:
                 f"tok/s={tokens_done / max(dt, 1e-6):.0f}",
                 flush=True,
             )
+    if ckpt is not None:
+        ckpt.close()
     manager.shutdown(wait=False)
     print(f"[replica {replica_id}] done", flush=True)
 
@@ -258,6 +303,11 @@ if __name__ == "__main__":
     parser.add_argument("--quantize", action="store_true",
                         help="fp8-compress the pseudogradient allreduce")
     parser.add_argument("--log-every", type=int, default=1)
+    parser.add_argument("--ckpt-dir", default="",
+                        help="directory for tier-2 durable checkpoints "
+                             "(empty = live healing only)")
+    parser.add_argument("--ckpt-every", type=int, default=100,
+                        help="durable-checkpoint interval in committed steps")
     parser.add_argument("--replica-id", type=int, default=0)
     parser.add_argument("--lighthouse", type=str, default="127.0.0.1:29510")
     parser.add_argument("--virtual-chips", type=int, default=0,

@@ -1,0 +1,192 @@
+"""Durable (tier-2) checkpointing tests (torchft_tpu/checkpointing/durable.py).
+
+The reference leaves periodic durable checkpoints to the user with a
+contract ("must include Manager.state_dict()", torchft manager.py:148-160);
+here the composition is first-class and these tests pin it: user state +
+manager clock + data position round-trip as one step, retention discards
+old steps, and interval gating saves only on the boundary.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.checkpointing import DurableCheckpointer
+from torchft_tpu.data import DistributedSampler, StatefulDataIterator
+
+
+class FakeManagerState:
+    def __init__(self, step=7, batches=14):
+        self._sd = {"step": step, "batches_committed": batches}
+
+    def state_dict(self):
+        return dict(self._sd)
+
+
+def make_state():
+    return {
+        "params": {
+            "w": jnp.arange(8, dtype=jnp.float32).reshape(2, 4),
+            "b": jnp.ones((3,), jnp.bfloat16),
+        },
+        "opt": [jnp.zeros((2, 4), jnp.float32)],
+    }
+
+
+class TestRoundtrip:
+    def test_save_restore_composite(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "ckpt"))
+        state = make_state()
+        sampler = DistributedSampler(100, 0, 0, 1, 2)
+        data_iter = StatefulDataIterator(sampler)
+        for _ in range(5):
+            next(data_iter)
+        assert ckpt.save(7, state, manager=FakeManagerState(),
+                         data_iter=data_iter)
+        ckpt.wait()
+
+        restored = ckpt.restore(state_template=make_state())
+        assert restored is not None
+        r_state, manager_sd, data_sd = restored
+        np.testing.assert_array_equal(
+            np.asarray(r_state["params"]["w"]),
+            np.asarray(state["params"]["w"]),
+        )
+        assert r_state["params"]["b"].dtype == jnp.bfloat16
+        assert manager_sd == {"step": 7, "batches_committed": 14}
+        fresh = StatefulDataIterator(DistributedSampler(100, 0, 0, 1, 2))
+        fresh.load_state_dict(data_sd)
+        assert fresh.state_dict() == data_iter.state_dict()
+        ckpt.close()
+
+    def test_restore_without_checkpoint_returns_none(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "empty"))
+        assert ckpt.restore() is None
+        ckpt.close()
+
+    def test_state_only_checkpoint(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "s"))
+        assert ckpt.save(1, {"x": jnp.ones(2)})
+        ckpt.wait()
+        r_state, manager_sd, data_sd = ckpt.restore()
+        np.testing.assert_array_equal(np.asarray(r_state["x"]), [1.0, 1.0])
+        assert manager_sd is None and data_sd is None
+        ckpt.close()
+
+    def test_restored_leaves_are_jax_arrays(self, tmp_path):
+        """With a template, restore places leaves like the template —
+        device arrays come back as device arrays."""
+        ckpt = DurableCheckpointer(str(tmp_path / "d"))
+        ckpt.save(3, make_state())
+        ckpt.wait()
+        r_state, _, _ = ckpt.restore(state_template=make_state())
+        assert isinstance(r_state["params"]["w"], jax.Array)
+        ckpt.close()
+
+
+class TestFullUserComposite:
+    """A durable checkpoint must capture the SAME composite live healing
+    transfers — including DiLoCo fragment globals and outer momentum — or
+    algorithm state silently resets on cold restart."""
+
+    def test_manager_user_state_dict_roundtrip_with_diloco(self, tmp_path):
+        import optax
+
+        from tests.test_local_sgd import MockManager as AlgoMockManager
+        from torchft_tpu.local_sgd import DiLoCo
+        from torchft_tpu.manager import Manager
+
+        # a real Manager purely for its state-registration plumbing
+        mgr = Manager.__new__(Manager)
+        from torchft_tpu.checkpointing._rwlock import RWLock
+
+        mgr._state_dict_lock = RWLock(timeout=5.0)
+        mgr._user_state_dicts = {}
+        mgr._load_state_dict_fns = {}
+        mgr._step, mgr._batches_committed = 0, 0
+
+        trainer_state = {"params": {"w": jnp.full((2,), 2.0, jnp.float32)}}
+        mgr.register_state_dict_fn(
+            "default",
+            lambda sd: trainer_state.update(sd),
+            lambda: dict(trainer_state),
+        )
+        algo_mgr = AlgoMockManager()
+        diloco = DiLoCo(algo_mgr, trainer_state["params"],
+                        optax.sgd(1.0, momentum=0.9), sync_every=2)
+        # re-register the fragment fns on the real manager's registry
+        for key, (load_fn, value_fn) in algo_mgr.state_fns.items():
+            mgr.register_state_dict_fn(key, load_fn, value_fn)
+
+        composite = mgr.user_state_dict()
+        assert "default" in composite
+        assert "StreamingDiLoCoFragment_0" in composite
+
+        ckpt = DurableCheckpointer(str(tmp_path / "full"))
+        ckpt.save(5, composite, manager=mgr)
+        ckpt.wait()
+
+        # cold restart: fresh fragment state, then restore the composite
+        diloco.fragments[0].original = [jnp.zeros((2,), jnp.float32)]
+        user_sd, manager_sd, _ = ckpt.restore(
+            state_template=mgr.user_state_dict()
+        )
+        mgr.load_user_state_dict(user_sd)
+        np.testing.assert_allclose(
+            np.asarray(diloco.fragments[0].original[0]), [2.0, 2.0]
+        )
+        assert manager_sd == {"step": 0, "batches_committed": 0}
+        ckpt.close()
+
+
+class TestRetentionAndInterval:
+    def test_max_to_keep(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "r"), max_to_keep=2)
+        for step in (1, 2, 3, 4):
+            ckpt.save(step, {"x": jnp.full((2,), float(step))})
+        ckpt.wait()
+        assert ckpt.all_steps() == [3, 4]
+        r_state, _, _ = ckpt.restore()
+        np.testing.assert_array_equal(np.asarray(r_state["x"]), [4.0, 4.0])
+        ckpt.close()
+
+    def test_maybe_save_interval(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "i"), max_to_keep=10,
+                                   save_interval_steps=5)
+        saves = [s for s in range(1, 13) if ckpt.maybe_save(s, {"x": jnp.ones(1)})]
+        ckpt.wait()
+        assert saves == [5, 10]
+        assert ckpt.latest_step() == 10
+        # duplicate step is a no-op
+        assert not ckpt.maybe_save(10, {"x": jnp.ones(1)})
+        ckpt.close()
+
+    def test_step_zero_never_saved(self, tmp_path):
+        """Init state must not burn a retention slot (regression)."""
+        ckpt = DurableCheckpointer(str(tmp_path / "s0"), save_interval_steps=5)
+        assert not ckpt.maybe_save(0, {"x": jnp.ones(1)})
+        assert ckpt.latest_step() is None
+        ckpt.close()
+
+    def test_callable_state_materialized_only_on_save(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "lazy"),
+                                   save_interval_steps=2)
+        calls = []
+
+        def state():
+            calls.append(1)
+            return {"x": jnp.ones(1)}
+
+        assert not ckpt.maybe_save(1, state)
+        assert calls == []  # off-interval: composite never built
+        assert ckpt.maybe_save(2, state)
+        assert calls == [1]
+        ckpt.close()
+
+    def test_interval_zero_never_autosaves(self, tmp_path):
+        ckpt = DurableCheckpointer(str(tmp_path / "z"))
+        assert not ckpt.maybe_save(5, {"x": jnp.ones(1)})
+        assert ckpt.latest_step() is None
+        ckpt.close()

@@ -1,6 +1,13 @@
 from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing.durable import DurableCheckpointer
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.checkpointing.pg_transport import PGTransport
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
-__all__ = ["RWLock", "CheckpointTransport", "HTTPTransport", "PGTransport"]
+__all__ = [
+    "RWLock",
+    "CheckpointTransport",
+    "DurableCheckpointer",
+    "HTTPTransport",
+    "PGTransport",
+]

@@ -41,12 +41,19 @@ __all__ = ["LocalSGD", "DiLoCo", "partition_fragments"]
 
 
 def _snapshot(tree: Any) -> Any:
-    """Rollback copy of a pytree. jax.Arrays are immutable — holding the
-    reference IS the snapshot; only mutable numpy leaves need a real copy."""
+    """Rollback copy of a pytree, donation-safe.
+
+    jax.Arrays are immutable but NOT deletion-proof: a train step jitted
+    with ``donate_argnums`` (the production default, parallel/mesh.py)
+    deletes the caller's param buffers, so a snapshot that merely holds the
+    reference dies with them. ``jnp.copy`` allocates a distinct device
+    buffer (same sharding) that donation can't touch."""
     import jax
+    import jax.numpy as jnp
 
     return jax.tree_util.tree_map(
-        lambda x: x if isinstance(x, jax.Array) else np.array(x, copy=True),
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array)
+        else np.array(x, copy=True),
         tree,
     )
 
@@ -141,14 +148,16 @@ class LocalSGD:
                     "LocalSGD: healed without get_params; averaging the "
                     "recovered backup instead of the stale local params"
                 )
-                params = _like(params, self._backup)
+                params = _like(params, _snapshot(self._backup))
         work = self._manager.allreduce(params, reduce_op=ReduceOp.AVG)
         averaged = work.get_future().wait()
         if self._manager.should_commit():
             self._backup = _snapshot(averaged)
             return _like(params, averaged)
         logger.warning("LocalSGD commit failed; restoring last synced params")
-        return _like(params, self._backup)
+        # snapshot again on the way out: the returned params may be donated
+        # by the caller's train step, which must not delete the backup
+        return _like(params, _snapshot(self._backup))
 
 
 def partition_fragments(leaves: Sequence[Any], num_fragments: int) -> List[List[int]]:
@@ -280,8 +289,14 @@ class _Fragment:
             isinstance(leaves[i], jax.Array) for i in leaf_indices
         )
         if self._on_device:
-            # jax.Arrays are immutable: the reference IS the backup
-            self.original: List[Any] = [leaves[i] for i in leaf_indices]
+            import jax.numpy as jnp
+
+            # device-resident globals in fragment-private buffers: the
+            # caller's train step may donate (delete) its param buffers,
+            # so aliasing them would kill the backup (see _snapshot)
+            self.original: List[Any] = [
+                jnp.copy(leaves[i]) for i in leaf_indices
+            ]
         else:
             # host mode mirrors the reference's CPU backups
             # (local_sgd.py:241-253)
@@ -407,13 +422,18 @@ class _Fragment:
         should_commit = self._manager.should_commit()
         if should_commit:
             if self._on_device:
+                import jax.numpy as jnp
+
                 grads = [
                     _like(t, g) for t, g in zip(restored, avg_pseudograds)
                 ]
                 new_global, self.outer_state, merged = self._outer_step_jit(
                     grads, self.outer_state, restored, local
                 )
-                self.original = list(new_global)
+                # private eager copies: with alpha=0 XLA may alias the
+                # merged and new_global outvars to one buffer, and merged
+                # is handed to a (possibly donating) caller
+                self.original = [jnp.copy(g) for g in new_global]
             else:
                 grads = [np.asarray(g) for g in avg_pseudograds]
                 updates, self.outer_state = self._outer_tx.update(
@@ -430,12 +450,18 @@ class _Fragment:
             for k, i in enumerate(self.leaf_indices):
                 leaves[i] = merged[k]
         else:
+            import jax.numpy as jnp
+
             logger.warning(
                 f"DiLoCo fragment {self._id}: commit failed; restoring global params"
             )
             for k, i in enumerate(self.leaf_indices):
+                # hand out a copy: the caller may donate what we return,
+                # which must never delete the fragment-private backup
                 leaves[i] = (
-                    restored[k] if self._on_device else restored[k].copy()
+                    jnp.copy(restored[k])
+                    if self._on_device
+                    else restored[k].copy()
                 )
         return should_commit
 
@@ -562,13 +588,15 @@ class DiLoCo:
                         "zero pseudogradient this cycle (pass get_params "
                         "for full-fidelity post-heal syncs)"
                     )
+                    import jax.numpy as jnp
+
                     for frag_ in self._fragments:
                         for k, i in enumerate(frag_.leaf_indices):
-                            # copy on the host path: numpy callers may
-                            # mutate params in place, which must not reach
-                            # the fragment's rollback backup
+                            # always a copy: host callers may mutate in
+                            # place, device callers may donate — neither
+                            # must reach the fragment's private backup
                             leaves[i] = (
-                                frag_.original[k]
+                                jnp.copy(frag_.original[k])
                                 if frag_._on_device
                                 else frag_.original[k].copy()
                             )

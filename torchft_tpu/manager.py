@@ -714,6 +714,25 @@ class Manager:
         periodic checkpoints (reference: manager.py:938-958)."""
         return {"step": self._step, "batches_committed": self._batches_committed}
 
+    def user_state_dict(self) -> Dict[str, Any]:
+        """Every registered user state (trainer state, DiLoCo fragment
+        globals + outer optimizer, LocalSGD backups, data position, ...)
+        under the read lock — the same composite live healing transfers.
+        Durable (tier-2) checkpoints should save THIS, not just the
+        trainer's own state, or algorithm state silently resets on a cold
+        restart."""
+        with self._state_dict_lock.r_lock():
+            return {key: fn() for key, fn in self._user_state_dicts.items()}
+
+    def load_user_state_dict(self, user_state: Dict[str, Any]) -> None:
+        """Feed a ``user_state_dict()`` composite back through every
+        registered load fn (the cold-restart counterpart of healing's
+        ``_apply_pending_state_dict``)."""
+        with self._state_dict_lock.w_lock():
+            for key, load_fn in self._load_state_dict_fns.items():
+                if key in user_state:
+                    load_fn(user_state[key])
+
     def current_step(self) -> int:
         return self._step
 

@@ -15,13 +15,20 @@ k/v [B, S, Hkv, hd] (GQA: Hq a multiple of Hkv), causal, scaled by
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["causal_attention", "xla_attention", "flash_attention_tpu"]
+__all__ = [
+    "causal_attention",
+    "xla_attention",
+    "flash_attention_tpu",
+    "splash_attention_tpu",
+]
 
 
 def _repeat_kv(q: jax.Array, k: jax.Array, v: jax.Array):
@@ -89,6 +96,74 @@ def flash_attention_tpu(
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=16)
+def _splash_kernel(n_q_heads: int, seq_len: int, block: int, interpret: bool):
+    """Build (and cache) a splash-attention kernel: mask construction and
+    kernel specialization are trace-time work worth amortizing.
+
+    Construction runs under ``ensure_compile_time_eval``: the kernel bakes
+    mask partials as arrays, and if those were created inside an outer trace
+    (first call typically happens inside a remat'd scan body) the cache
+    would leak that trace's tracers into every later jaxpr.
+    """
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    mask = sm.MultiHeadMask(
+        [sm.CausalMask((seq_len, seq_len))] * n_q_heads
+    )
+    block = min(block, seq_len)
+    bs = sk.BlockSizes(
+        block_q=block,
+        block_kv=block,
+        block_kv_compute=block,
+        block_q_dkv=block,
+        block_kv_dkv=block,
+        block_kv_dkv_compute=block,
+        block_q_dq=block,
+        block_kv_dq=block,
+    )
+    with jax.ensure_compile_time_eval():
+        return sk.make_splash_mha(
+            mask=mask,
+            block_sizes=bs,
+            head_shards=1,
+            q_seq_shards=1,
+            interpret=interpret,
+        )
+
+
+def splash_attention_tpu(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    cfg: Any,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA-native splash attention (fwd+bwd Pallas kernels).
+
+    Unlike `flash_attention_tpu` this never materializes the repeated K/V
+    heads: the kernel maps query-head groups onto shared KV heads directly,
+    cutting attention HBM traffic by the GQA group factor (4x for the
+    llama3 configs). The reference has no attention kernels of its own (it
+    delegates to torchtitan/PyTorch SDPA); this is the framework's.
+    """
+    hd = q.shape[-1]
+    # kernel layout is [heads, S, hd] per example; scale folded into q
+    # (splash takes no sm_scale argument)
+    scale = 1.0 / math.sqrt(hd)
+    qt = (jnp.swapaxes(q, 1, 2) * jnp.asarray(scale, q.dtype))
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    S = qt.shape[2]
+    blk = next(b for b in (512, 256, 128) if S % b == 0)
+    kernel = _splash_kernel(qt.shape[1], S, blk, interpret)
+    out = jax.vmap(kernel)(qt, kt, vt)  # [B, Hq, S, hd]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
 def _on_tpu() -> bool:
     # not cached: the active backend can change in-process (e.g. a virtual
     # CPU device context during dryruns), and default_backend() is cheap
@@ -96,9 +171,18 @@ def _on_tpu() -> bool:
 
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: Any) -> jax.Array:
-    """Backend-dispatching causal attention: Pallas flash on TPU (when the
-    sequence tiles cleanly), XLA fallback elsewhere."""
+    """Backend-dispatching causal attention.
+
+    On TPU (sequence tiling permitting): splash attention when the model is
+    GQA/MQA (KV heads stay unrepeated — group-factor less HBM traffic),
+    plain flash otherwise. XLA fallback elsewhere. Override with
+    ``TORCHFT_TPU_ATTENTION=splash|flash|xla`` (benchmark escape hatch).
+    """
     S, hd = q.shape[1], q.shape[-1]
-    if _on_tpu() and S % 128 == 0 and hd in (64, 128, 256):
-        return flash_attention_tpu(q, k, v, cfg)
-    return xla_attention(q, k, v, cfg)
+    tileable = S % 128 == 0 and hd in (64, 128, 256)
+    choice = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
+    if choice == "xla" or not (_on_tpu() and tileable):
+        return xla_attention(q, k, v, cfg)
+    if choice == "splash" or (choice == "auto" and q.shape[2] != k.shape[2]):
+        return splash_attention_tpu(q, k, v, cfg)
+    return flash_attention_tpu(q, k, v, cfg)

@@ -20,7 +20,8 @@ import sys
 import time
 
 
-def timed_train_step(cfg, batch, seq, steps, remat="dots", lr=3e-4):
+def timed_train_step(cfg, batch, seq, steps, remat="dots", lr=3e-4,
+                     loss_chunk=0):
     """Compile and time the bf16 adamw train step; returns (tokens/s, mfu).
 
     One shared harness for bench.py and the sweep: jit with donated
@@ -41,7 +42,7 @@ def timed_train_step(cfg, batch, seq, steps, remat="dots", lr=3e-4):
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(llama_loss)(
-            params, tokens, targets, cfg, remat=remat
+            params, tokens, targets, cfg, remat=remat, loss_chunk=loss_chunk
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -102,7 +103,27 @@ def main() -> None:
         cfg = CONFIGS["tiny"]
         batch, seq, steps = 4, 256, 3
 
-    tokens_per_sec, mfu = timed_train_step(cfg, batch, seq, steps)
+    # attention-kernel fallback chain: the bench must survive a Pallas
+    # kernel regressing on new hardware/toolchains — a slower number beats
+    # a zero. Dispatch honors TORCHFT_TPU_ATTENTION (ops/attention.py).
+    import os
+
+    attention_modes = (
+        [os.environ["TORCHFT_TPU_ATTENTION"]]
+        if os.environ.get("TORCHFT_TPU_ATTENTION")
+        else ["auto", "flash", "xla"]
+    )
+    last_err = None
+    for mode in attention_modes:
+        os.environ["TORCHFT_TPU_ATTENTION"] = mode
+        try:
+            tokens_per_sec, mfu = timed_train_step(cfg, batch, seq, steps)
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            print(f"# attention mode {mode!r} failed: {e}", file=sys.stderr)
+    else:
+        raise last_err
     n_params = cfg.num_params()
 
     record = {
@@ -113,6 +134,9 @@ def main() -> None:
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
+        # which kernel actually produced the number: a silent fallback to
+        # the slow path must be visible in the artifact, not just stderr
+        "attention_mode": mode,
     }
 
     # FT metrics ride the same line; a failure here must never cost the

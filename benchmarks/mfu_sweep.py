@@ -25,13 +25,19 @@ def main():
                  "for hours on CPU (use bench.py, which falls back to tiny).")
     cfg = CONFIGS["bench_350m"]
     seq = 2048
-    for remat_mode, batch in itertools.product(["full", "dots", "none"], [8, 16, 32]):
+    attn = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
+    for remat_mode, batch, chunk in itertools.product(
+        ["dots", "none", "full"], [8, 16, 32], [0, 512]
+    ):
         try:
-            tps, mfu = timed_train_step(cfg, batch, seq, steps=10, remat=remat_mode)
-            print(f"remat={remat_mode:5s} batch={batch:3d}: "
-                  f"{tps:10.1f} tok/s  MFU={mfu:.4f}", flush=True)
+            tps, mfu = timed_train_step(cfg, batch, seq, steps=10,
+                                        remat=remat_mode, loss_chunk=chunk)
+            print(f"attn={attn} remat={remat_mode:5s} batch={batch:3d} "
+                  f"chunk={chunk:4d}: {tps:10.1f} tok/s  MFU={mfu:.4f}",
+                  flush=True)
         except Exception as e:
-            print(f"remat={remat_mode:5s} batch={batch:3d}: FAILED "
+            print(f"attn={attn} remat={remat_mode:5s} batch={batch:3d} "
+                  f"chunk={chunk:4d}: FAILED "
                   f"{type(e).__name__}: {str(e)[:120]}", flush=True)
 
 

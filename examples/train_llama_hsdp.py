@@ -235,6 +235,11 @@ def train(args) -> None:
                 f"tok/s={tokens_done / max(dt, 1e-6):.0f}",
                 flush=True,
             )
+    if diloco is not None:
+        # the loop may stop between a fragment's prepare and perform
+        # boundaries; finish the in-flight sync so peers aren't left
+        # waiting on an abandoned commit round
+        state["params"] = diloco.flush(state["params"])
     if ckpt is not None:
         ckpt.close()
     manager.shutdown(wait=False)

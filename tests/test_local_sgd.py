@@ -573,6 +573,34 @@ class TestDonationSafety:
         assert np.isfinite(np.asarray(out2["w"])).all()
 
 
+class TestFlush:
+    def test_flush_completes_inflight_sync(self):
+        """A loop stopping between prepare and perform must be able to
+        finish the in-flight allreduce + commit vote instead of abandoning
+        it (peers block on the uncast vote otherwise)."""
+        m = MockManager()
+        params = {"w": np.array([1.0], dtype=np.float32)}
+        diloco = DiLoCo(m, params, optax.sgd(1.0), sync_every=3,
+                        fragment_sync_delay=1)
+        for _ in range(2):  # stops right after the prepare boundary
+            params = {"w": params["w"] - 0.1}
+            params = diloco.step(params)
+        assert diloco.fragments[0]._work is not None  # in flight
+        params = diloco.flush(params)
+        assert diloco.fragments[0]._work is None
+        assert m.commit_calls == 1  # the vote was cast
+        # pseudograd captured at prepare (1.0 - 0.8 = 0.2) -> global 0.8
+        np.testing.assert_allclose(params["w"], [0.8], rtol=1e-6)
+
+    def test_flush_noop_when_idle(self):
+        m = MockManager()
+        params = {"w": np.array([1.0], dtype=np.float32)}
+        diloco = DiLoCo(m, params, optax.sgd(1.0), sync_every=2)
+        out = diloco.flush(params)
+        assert m.commit_calls == 0
+        np.testing.assert_allclose(out["w"], [1.0])
+
+
 class TestPartitionFragments:
     def test_balanced_and_complete(self):
         leaves = [np.zeros(100), np.zeros(1), np.zeros(50), np.zeros(49)]

@@ -55,6 +55,33 @@ class TestLlama:
         )
         assert all(l > 0 for l in leaves), "some parameter got zero gradient"
 
+    @pytest.mark.parametrize("chunk", [4, 8, 16])
+    def test_chunked_loss_matches_full(self, params, chunk):
+        """loss_chunk changes HBM residency, never the math: value and
+        gradients must equal the full-logits path."""
+        key = jax.random.PRNGKey(2)
+        tokens = jax.random.randint(key, (2, 16), 0, CFG.vocab_size)
+        full = llama_loss(params, tokens, tokens, CFG)
+        chunked = llama_loss(params, tokens, tokens, CFG, loss_chunk=chunk)
+        np.testing.assert_allclose(float(full), float(chunked), rtol=1e-6)
+        g_full = jax.grad(llama_loss)(params, tokens, tokens, CFG)
+        g_chunk = jax.grad(
+            lambda p: llama_loss(p, tokens, tokens, CFG, loss_chunk=chunk)
+        )(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_full),
+            jax.tree_util.tree_leaves(g_chunk),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-3, atol=1e-5,
+            )
+
+    def test_chunk_must_divide_seq(self, params):
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="divide"):
+            llama_loss(params, tokens, tokens, CFG, loss_chunk=5)
+
     def test_num_params_formula(self):
         p = llama_init(jax.random.PRNGKey(0), CFG)
         actual = sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(p))

@@ -634,6 +634,35 @@ class DiLoCo:
                 leaves[i] = _like(orig, leaves[i])
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
+    def flush(self, params: Any) -> Any:
+        """Complete any in-flight fragment sync: waits the pseudogradient
+        allreduce, casts the two-phase commit vote, applies the outer step.
+
+        Call before shutting down a trainer whose loop may stop between a
+        prepare boundary and its perform boundary (``fragment_sync_delay >
+        0``) — abandoning the in-flight collective would leave peers waiting
+        on a commit round this replica never votes. No-op when nothing is
+        in flight. Returns the (possibly synced) params.
+        """
+        import jax
+
+        pending = [f for f in self._fragments if f._work is not None]
+        if not pending:
+            return params
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        changed: List[int] = []
+        for frag in pending:
+            logger.info(f"DiLoCo: flushing in-flight sync of fragment {frag._id}")
+            frag.perform_sync(leaves)
+            changed.extend(frag.leaf_indices)
+        self._local_step = 0
+        orig_leaves = jax.tree_util.tree_leaves(params)
+        for i in changed:
+            orig = orig_leaves[i]
+            if isinstance(orig, jax.Array):
+                leaves[i] = _like(orig, leaves[i])
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     # introspection used by tests
     @property
     def fragments(self) -> List[_Fragment]:

@@ -28,6 +28,7 @@ from torchft_tpu.models.remat import ATTN_OUT_NAME, remat_wrap
 __all__ = [
     "LlamaConfig",
     "llama_init",
+    "llama_hidden",
     "llama_forward",
     "llama_loss",
     "CONFIGS",
@@ -156,24 +157,16 @@ def _attention(
     return causal_attention(q, k, v, cfg)
 
 
-def llama_forward(
+def llama_hidden(
     params: Dict[str, Any],
     tokens: jax.Array,
     cfg: LlamaConfig,
     attention_fn: Optional[Any] = None,
     remat: Any = "dots",
 ) -> jax.Array:
-    """tokens: int32 [B, S] -> logits f32 [B, S, vocab].
-
-    ``attention_fn(q, k, v, cfg)`` can be swapped for a sharded/ring variant
-    (torchft_tpu/parallel/ring_attention.py) without touching the rest of the
-    stack.
-
-    ``remat`` selects the rematerialization mode for the scanned layer body —
-    see `torchft_tpu.models.remat.remat_wrap`. Default "dots" saves matmul
-    outputs and recomputes the rest, trading HBM for ~25% fewer backward
-    FLOPs vs full remat; pass "full" for models at the edge of HBM.
-    """
+    """tokens: int32 [B, S] -> final-norm hidden states [B, S, dim]
+    (everything except the lm_head projection — see `llama_loss`'s chunked
+    path, which applies the head per sequence chunk)."""
     attention = attention_fn or _attention
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -198,9 +191,29 @@ def llama_forward(
     # scan over stacked layers: one compiled body, L iterations
     body = remat_wrap(layer, remat)
     h, _ = jax.lax.scan(body, h, params["layers"])
-    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
-    logits = (h @ params["lm_head"]).astype(jnp.float32)
-    return logits
+    return _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+
+
+def llama_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    attention_fn: Optional[Any] = None,
+    remat: Any = "dots",
+) -> jax.Array:
+    """tokens: int32 [B, S] -> logits f32 [B, S, vocab].
+
+    ``attention_fn(q, k, v, cfg)`` can be swapped for a sharded/ring variant
+    (torchft_tpu/parallel/ring_attention.py) without touching the rest of the
+    stack.
+
+    ``remat`` selects the rematerialization mode for the scanned layer body —
+    see `torchft_tpu.models.remat.remat_wrap`. Default "dots" saves matmul
+    outputs and recomputes the rest, trading HBM for ~25% fewer backward
+    FLOPs vs full remat; pass "full" for models at the edge of HBM.
+    """
+    h = llama_hidden(params, tokens, cfg, attention_fn=attention_fn, remat=remat)
+    return (h @ params["lm_head"]).astype(jnp.float32)
 
 
 def llama_loss(
@@ -210,6 +223,7 @@ def llama_loss(
     cfg: LlamaConfig,
     attention_fn: Optional[Any] = None,
     remat: Any = "dots",
+    loss_chunk: int = 0,
 ) -> jax.Array:
     """Mean next-token cross-entropy.
 
@@ -217,8 +231,43 @@ def llama_loss(
     log_softmax: the latter materializes a second [B, S, vocab] f32 array in
     HBM, which at vocab ~2GB per step dominates the loss cost on TPU
     (~6% step-time win on the bench config).
+
+    ``loss_chunk > 0`` scans the loss over sequence chunks of that length
+    with per-chunk rematerialization: peak HBM for logits drops from
+    [B, S, vocab] f32 to [B, chunk, vocab] (the backward recomputes each
+    chunk's logits instead of keeping them all resident). Trades one extra
+    lm_head matmul per chunk in backward for vocab-sized activation memory —
+    the standard trade for big-vocab models at the HBM edge.
     """
-    logits = llama_forward(params, tokens, cfg, attention_fn=attention_fn, remat=remat)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt)
+    if loss_chunk <= 0:
+        logits = llama_forward(
+            params, tokens, cfg, attention_fn=attention_fn, remat=remat
+        )
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - tgt)
+
+    B, S = tokens.shape
+    if S % loss_chunk != 0:
+        raise ValueError(f"loss_chunk {loss_chunk} must divide seq len {S}")
+    h = llama_hidden(
+        params, tokens, cfg, attention_fn=attention_fn, remat=remat
+    )
+    n = S // loss_chunk
+    # [n, B, chunk, ...]: scan over sequence chunks
+    h_c = jnp.swapaxes(h.reshape(B, n, loss_chunk, -1), 0, 1)
+    t_c = jnp.swapaxes(targets.reshape(B, n, loss_chunk), 0, 1)
+    lm_head = params["lm_head"]
+
+    def chunk_sum(hc, tc):
+        logits = (hc @ lm_head).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return jnp.sum(lse - tgt)
+
+    def body(acc, xs):
+        hc, tc = xs
+        return acc + jax.checkpoint(chunk_sum)(hc, tc), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h_c, t_c))
+    return total / (B * S)

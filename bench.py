@@ -113,18 +113,22 @@ def main() -> None:
         if os.environ.get("TORCHFT_TPU_ATTENTION")
         else ["auto", "flash", "xla"]
     )
-    last_err = None
+    first_err = None
     for mode in attention_modes:
         os.environ["TORCHFT_TPU_ATTENTION"] = mode
         try:
             tokens_per_sec, mfu = timed_train_step(cfg, batch, seq, steps)
             break
         except Exception as e:  # noqa: BLE001
-            last_err = e
+            # the first failure is the root cause (later modes usually fail
+            # identically for non-attention errors)
+            first_err = first_err or e
             print(f"# attention mode {mode!r} failed: {e}", file=sys.stderr)
     else:
-        raise last_err
+        raise first_err
     n_params = cfg.num_params()
+
+    from torchft_tpu.ops import attention as _attn
 
     record = {
         "metric": (
@@ -134,9 +138,10 @@ def main() -> None:
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
-        # which kernel actually produced the number: a silent fallback to
-        # the slow path must be visible in the artifact, not just stderr
-        "attention_mode": mode,
+        # the kernel that actually produced the number (requested:resolved):
+        # a silent in-dispatch fallback to the slow path must be visible in
+        # the artifact, not just implied by the requested mode
+        "attention_mode": f"{mode}:{_attn.LAST_DISPATCH}",
     }
 
     # FT metrics ride the same line; a failure here must never cost the

@@ -188,61 +188,66 @@ def train(args) -> None:
     else:
         per_cycle = 0  # unused
         done = lambda: manager.current_step() >= args.steps  # noqa: E731
-    while not done():
-        batch = jax.device_put(
-            jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, S))), tok_sharding
-        )
+    # try/finally: the abandoned-commit-round protection (flush) and the
+    # checkpoint/manager teardown must run on SIGINT/preemption/exception
+    # exits too, not just the clean path
+    try:
+        while not done():
+            batch = jax.device_put(
+                jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, S))), tok_sharding
+            )
+            if diloco is not None:
+                # inner step: local grads + local adamw, no cross-group traffic
+                loss, grads = grad_step(state["params"], batch, batch)
+                state["params"], state["opt_state"] = update_step(
+                    state["params"], state["opt_state"], grads
+                )
+                # on a heal, diloco.step re-reads state["params"] via get_params
+                # and returns the healed pytree
+                state["params"] = diloco.step(state["params"])
+                # resume/catch-up: committed quorums are the global clock
+                inner_step = max(inner_step + 1,
+                                 manager.current_step() * per_cycle)
+                tokens_done += B * S
+            else:
+                manager.start_quorum()
+                loss, grads = grad_step(state["params"], batch, batch)
+                reduced = manager.allreduce(grads).get_future().wait(
+                    timeout=args.timeout
+                )
+                if not manager.should_commit():
+                    continue
+                state["params"], state["opt_state"] = update_step(
+                    state["params"], state["opt_state"], reduced
+                )
+                tokens_done += B * S * manager.num_participants()
+                inner_step += 1
+            # gate on the count that actually advances every loop iteration:
+            # in DiLoCo mode manager.current_step is constant across a whole
+            # inner window (bursty/silent logs); inner_step is not
+            if ckpt is not None:
+                # lazy: the full registered composite (trainer + algorithm
+                # state) is only materialized on the save interval
+                ckpt.maybe_save(manager.current_step(), manager.user_state_dict,
+                                manager=manager)
+            if inner_step % args.log_every == 0:
+                dt = time.monotonic() - t0
+                print(
+                    f"[replica {replica_id}] step={manager.current_step()} "
+                    f"inner={inner_step} loss={float(loss):.4f} "
+                    f"participants={manager.num_participants()} "
+                    f"tok/s={tokens_done / max(dt, 1e-6):.0f}",
+                    flush=True,
+                )
+    finally:
         if diloco is not None:
-            # inner step: local grads + local adamw, no cross-group traffic
-            loss, grads = grad_step(state["params"], batch, batch)
-            state["params"], state["opt_state"] = update_step(
-                state["params"], state["opt_state"], grads
-            )
-            # on a heal, diloco.step re-reads state["params"] via get_params
-            # and returns the healed pytree
-            state["params"] = diloco.step(state["params"])
-            # resume/catch-up: committed quorums are the global clock
-            inner_step = max(inner_step + 1,
-                             manager.current_step() * per_cycle)
-            tokens_done += B * S
-        else:
-            manager.start_quorum()
-            loss, grads = grad_step(state["params"], batch, batch)
-            reduced = manager.allreduce(grads).get_future().wait(
-                timeout=args.timeout
-            )
-            if not manager.should_commit():
-                continue
-            state["params"], state["opt_state"] = update_step(
-                state["params"], state["opt_state"], reduced
-            )
-            tokens_done += B * S * manager.num_participants()
-            inner_step += 1
-        # gate on the count that actually advances every loop iteration:
-        # in DiLoCo mode manager.current_step is constant across a whole
-        # inner window (bursty/silent logs); inner_step is not
+            # the loop may stop between a fragment's prepare and perform
+            # boundaries (or be interrupted there); finish the in-flight
+            # sync so peers aren't left waiting on an abandoned commit round
+            state["params"] = diloco.flush(state["params"])
         if ckpt is not None:
-            # lazy: the full registered composite (trainer + algorithm
-            # state) is only materialized on the save interval
-            ckpt.maybe_save(manager.current_step(), manager.user_state_dict,
-                            manager=manager)
-        if inner_step % args.log_every == 0:
-            dt = time.monotonic() - t0
-            print(
-                f"[replica {replica_id}] step={manager.current_step()} "
-                f"inner={inner_step} loss={float(loss):.4f} "
-                f"participants={manager.num_participants()} "
-                f"tok/s={tokens_done / max(dt, 1e-6):.0f}",
-                flush=True,
-            )
-    if diloco is not None:
-        # the loop may stop between a fragment's prepare and perform
-        # boundaries; finish the in-flight sync so peers aren't left
-        # waiting on an abandoned commit round
-        state["params"] = diloco.flush(state["params"])
-    if ckpt is not None:
-        ckpt.close()
-    manager.shutdown(wait=False)
+            ckpt.close()
+        manager.shutdown(wait=False)
     print(f"[replica {replica_id}] done", flush=True)
 
 

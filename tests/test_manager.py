@@ -97,6 +97,20 @@ class TestQuorumHappyPath:
         # dummy PG world 1: sum == input, then divided by num_participants=2
         np.testing.assert_allclose(out["w"], 2.0)
 
+    def test_allreduce_chain_race_many_iterations(self):
+        """Host-plane staging resolves on a background thread; the chain
+        must always deliver the rebuilt pytree, never the raw leaf list
+        (regression: the staging closure captured a rebound variable, so
+        when the instant-resolving PG won the race the caller got the
+        pre-normalize list)."""
+        m = make_manager(quorum=make_quorum())
+        m.start_quorum()
+        grads = {"w": np.full((3,), 4.0, dtype=np.float32)}
+        for _ in range(200):
+            out = m.allreduce(grads).get_future().wait(timeout=10)
+            assert isinstance(out, dict), f"raw leaves leaked: {type(out)}"
+            np.testing.assert_allclose(out["w"], 2.0)
+
     def test_allreduce_sum_no_normalize(self):
         m = make_manager(quorum=make_quorum())
         m.start_quorum()

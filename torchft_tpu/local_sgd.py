@@ -622,11 +622,21 @@ class DiLoCo:
         )
         if not changed_indices:
             return params
-        # Re-place only the synced fragment's leaves; the other fragments'
-        # jax.Arrays pass through untouched (streaming DiLoCo's point is that
-        # a sync boundary touches 1/num_fragments of the model).
+        return self._replace_synced(params, leaves, treedef, changed_indices)
+
+    @staticmethod
+    def _replace_synced(
+        params: Any, leaves: List[Any], treedef: Any, changed: List[int]
+    ) -> Any:
+        """Rebuild params with the synced leaves re-placed onto their
+        original device/sharding. Only the changed indices are touched —
+        the other fragments' jax.Arrays pass through untouched (streaming
+        DiLoCo's point is that a sync boundary touches 1/num_fragments of
+        the model)."""
+        import jax
+
         orig_leaves = jax.tree_util.tree_leaves(params)
-        for i in changed_indices:
+        for i in changed:
             orig = orig_leaves[i]
             if isinstance(orig, jax.Array):
                 # device-path leaves are already jax.Arrays — _like is a
@@ -656,12 +666,7 @@ class DiLoCo:
             frag.perform_sync(leaves)
             changed.extend(frag.leaf_indices)
         self._local_step = 0
-        orig_leaves = jax.tree_util.tree_leaves(params)
-        for i in changed:
-            orig = orig_leaves[i]
-            if isinstance(orig, jax.Array):
-                leaves[i] = _like(orig, leaves[i])
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+        return self._replace_synced(params, leaves, treedef, changed)
 
     # introspection used by tests
     @property

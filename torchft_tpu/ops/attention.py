@@ -170,6 +170,13 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# Which kernel the last causal_attention dispatch resolved to ("splash" /
+# "flash" / "xla"). Set at trace time; benchmarks record it so a silent
+# fallback to the slow path is visible in their artifacts, not just implied
+# by the requested mode.
+LAST_DISPATCH: "str | None" = None
+
+
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: Any) -> jax.Array:
     """Backend-dispatching causal attention.
 
@@ -178,11 +185,15 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, cfg: Any) -> jax.
     plain flash otherwise. XLA fallback elsewhere. Override with
     ``TORCHFT_TPU_ATTENTION=splash|flash|xla`` (benchmark escape hatch).
     """
+    global LAST_DISPATCH
     S, hd = q.shape[1], q.shape[-1]
     tileable = S % 128 == 0 and hd in (64, 128, 256)
     choice = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
     if choice == "xla" or not (_on_tpu() and tileable):
+        LAST_DISPATCH = "xla"
         return xla_attention(q, k, v, cfg)
     if choice == "splash" or (choice == "auto" and q.shape[2] != k.shape[2]):
+        LAST_DISPATCH = "splash"
         return splash_attention_tpu(q, k, v, cfg)
+    LAST_DISPATCH = "flash"
     return flash_attention_tpu(q, k, v, cfg)

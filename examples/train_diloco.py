@@ -17,6 +17,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def train(args) -> None:
+    if args.virtual_chips:
+        # local multi-process runs share no TPU; use a virtual CPU platform
+        from torchft_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.virtual_chips)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -88,6 +94,9 @@ def train(args) -> None:
         num_fragments=args.num_fragments,
         fragment_sync_delay=args.fragment_sync_delay,
         fragment_update_alpha=args.fragment_update_alpha,
+        # a live heal rebinds state["params"]; DiLoCo must re-read them
+        # instead of computing pseudogradients from stale pre-heal leaves
+        get_params=lambda: state["params"],
     )
 
     rng = np.random.RandomState(replica_id)
@@ -101,26 +110,37 @@ def train(args) -> None:
 
     target_outer_steps = args.steps // args.sync_every * args.num_fragments
     local = 0
-    while manager.current_step() < target_outer_steps:
-        x = jnp.asarray(rng.randn(args.batch_size, 32), jnp.float32)
-        y = jnp.asarray(rng.randint(0, 10, size=(args.batch_size,)))
-        state["params"], state["inner"], loss = inner_step(
-            state["params"], state["inner"], x, y
-        )
-        state["params"] = diloco.step(state["params"])
-        local += 1
-        if local % args.sync_every == 0:
-            print(
-                f"[replica {replica_id}] outer_step={manager.current_step()} "
-                f"local={local} loss={float(loss):.4f}",
-                flush=True,
+    try:
+        while manager.current_step() < target_outer_steps:
+            x = jnp.asarray(rng.randn(args.batch_size, 32), jnp.float32)
+            y = jnp.asarray(rng.randint(0, 10, size=(args.batch_size,)))
+            state["params"], state["inner"], loss = inner_step(
+                state["params"], state["inner"], x, y
             )
+            state["params"] = diloco.step(state["params"])
+            local += 1
+            if local % args.sync_every == 0:
+                print(
+                    f"[replica {replica_id}] outer_step={manager.current_step()} "
+                    f"local={local} loss={float(loss):.4f}",
+                    flush=True,
+                )
+    finally:
+        try:
+            # never strand peers on an in-flight commit round, even on
+            # interrupted exits; best-effort — a flush failing on a dead
+            # wire must not mask the original exception or skip shutdown
+            state["params"] = diloco.flush(state["params"])
+        except Exception as e:  # noqa: BLE001
+            print(f"[replica {replica_id}] flush failed during teardown: {e}",
+                  flush=True)
+        finally:
+            manager.shutdown(wait=False)
     w_sum = sum(
         float(jnp.sum(jnp.abs(diloco.fragments[i].original[0])))
         for i in range(len(diloco.fragments))
     )
     print(f"[replica {replica_id}] done: global_l1[frag0]={w_sum:.6f}", flush=True)
-    manager.shutdown(wait=False)
 
 
 def demo(args) -> None:
@@ -140,7 +160,8 @@ def demo(args) -> None:
         return subprocess.Popen(
             [sys.executable, __file__, "--steps", str(args.steps),
              "--sync-every", str(args.sync_every),
-             "--num-fragments", str(args.num_fragments)],
+             "--num-fragments", str(args.num_fragments),
+             "--virtual-chips", "1"],
             env=env,
         )
 
@@ -171,6 +192,8 @@ if __name__ == "__main__":
     parser.add_argument("--num-fragments", type=int, default=2)
     parser.add_argument("--fragment-sync-delay", type=int, default=0)
     parser.add_argument("--fragment-update-alpha", type=float, default=0.0)
+    parser.add_argument("--virtual-chips", type=int, default=0,
+                        help="force N virtual CPU devices (local multi-process runs)")
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--replica-id", type=int, default=0)
     parser.add_argument("--lighthouse", type=str, default="127.0.0.1:29510")

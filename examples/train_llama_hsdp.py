@@ -240,14 +240,21 @@ def train(args) -> None:
                     flush=True,
                 )
     finally:
-        if diloco is not None:
-            # the loop may stop between a fragment's prepare and perform
-            # boundaries (or be interrupted there); finish the in-flight
-            # sync so peers aren't left waiting on an abandoned commit round
-            state["params"] = diloco.flush(state["params"])
-        if ckpt is not None:
-            ckpt.close()
-        manager.shutdown(wait=False)
+        try:
+            if diloco is not None:
+                # the loop may stop between a fragment's prepare and perform
+                # boundaries (or be interrupted there); finish the in-flight
+                # sync so peers aren't left waiting on an abandoned commit
+                # round. Best-effort: a flush failing on a dead wire must
+                # not mask the original exception or skip the teardown.
+                state["params"] = diloco.flush(state["params"])
+        except Exception as e:  # noqa: BLE001
+            print(f"[replica {replica_id}] flush failed during teardown: {e}",
+                  flush=True)
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+            manager.shutdown(wait=False)
     print(f"[replica {replica_id}] done", flush=True)
 
 

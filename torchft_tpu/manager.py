@@ -830,7 +830,11 @@ class Manager:
         if self._store is not None:
             self._store.shutdown()
         self._executor.shutdown(wait=wait)
-        self._staging_executor.shutdown(wait=wait)
+        # cancel queued (not-yet-run) staging tasks on a non-waiting
+        # shutdown: they would otherwise dispatch against the PG after
+        # pg.shutdown below, spuriously reporting errors on a torn-down
+        # manager
+        self._staging_executor.shutdown(wait=wait, cancel_futures=not wait)
         self._pg.shutdown()
 
     @property

@@ -260,6 +260,11 @@ class Manager:
         self._staging_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="torchft_stage"
         )
+        # (executor future, staged future) pairs still in flight: shutdown
+        # must fail the staged futures of cancelled tasks or their waiters
+        # stall for the full timeout
+        self._staged_pending: List[Any] = []
+        self._staged_lock = threading.Lock()
         self._quorum_future: Optional[Any] = None
 
         self._logger = _ManagerLogger(self, self._replica_id, group_rank)
@@ -617,7 +622,12 @@ class Manager:
                         except RuntimeError:
                             pass
 
-                self._staging_executor.submit(stage)
+                exec_fut = self._staging_executor.submit(stage)
+                with self._staged_lock:
+                    self._staged_pending = [
+                        p for p in self._staged_pending if not p[1].done()
+                    ]
+                    self._staged_pending.append((exec_fut, staged_fut))
 
             fut = fut.then(normalize)
             fut = self.wrap_future(fut, zeros())
@@ -833,8 +843,19 @@ class Manager:
         # cancel queued (not-yet-run) staging tasks on a non-waiting
         # shutdown: they would otherwise dispatch against the PG after
         # pg.shutdown below, spuriously reporting errors on a torn-down
-        # manager
+        # manager — and fail their staged futures so any waiter unblocks
+        # immediately instead of riding out the full timeout
         self._staging_executor.shutdown(wait=wait, cancel_futures=not wait)
+        with self._staged_lock:
+            pending, self._staged_pending = self._staged_pending, []
+        for exec_fut, staged_fut in pending:
+            if exec_fut.cancelled() and not staged_fut.done():
+                try:
+                    staged_fut.set_exception(
+                        RuntimeError("manager shut down before dispatch")
+                    )
+                except RuntimeError:
+                    pass
         self._pg.shutdown()
 
     @property

@@ -111,6 +111,60 @@ class TestQuorumHappyPath:
             assert isinstance(out, dict), f"raw leaves leaked: {type(out)}"
             np.testing.assert_allclose(out["w"], 2.0)
 
+    def test_shutdown_fails_queued_staging_promptly(self):
+        """shutdown(wait=False) must fail the staged future of a queued
+        (never-dispatched) host-plane allreduce immediately — not leave its
+        waiter to ride out the full timeout (regression)."""
+        import threading
+        import time as _time
+
+        from torchft_tpu.process_group import ProcessGroup
+
+        release = threading.Event()
+
+        class SlowPG(ProcessGroup):
+            def configure(self, *a, **k):
+                pass
+
+            def allreduce(self, arrays, op=ReduceOp.SUM):
+                release.wait(5)  # occupy the staging worker
+                from torchft_tpu.work import DummyWork
+
+                return DummyWork(list(arrays))
+
+            def errored(self):
+                return None
+
+            def abort(self):
+                pass
+
+            def shutdown(self):
+                release.set()
+
+            def size(self):
+                return 1
+
+            def rank(self):
+                return 0
+
+            def allgather(self, arrays):  # pragma: no cover - unused
+                raise NotImplementedError
+
+            broadcast = reduce_scatter = alltoall = send = recv = allgather
+
+        m = make_manager(pg=SlowPG(), quorum=make_quorum(), timeout=30.0)
+        m.start_quorum()
+        first = m.allreduce({"w": np.ones(2, np.float32)})
+        second = m.allreduce({"w": np.ones(2, np.float32)})  # queued
+        t0 = _time.monotonic()
+        m.shutdown(wait=False)
+        # swallow-to-default semantics: the failed dispatch resolves to the
+        # zeros default well before the 30s manager timeout
+        out = second.get_future().wait(timeout=10)
+        assert _time.monotonic() - t0 < 8.0
+        np.testing.assert_allclose(out["w"], 0.0)
+        first.get_future().wait(timeout=10)
+
     def test_allreduce_sum_no_normalize(self):
         m = make_manager(quorum=make_quorum())
         m.start_quorum()

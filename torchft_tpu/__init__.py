@@ -28,6 +28,7 @@ _EXPORTS = {
     "StatefulDataIterator": "torchft_tpu.data",
     "HTTPTransport": "torchft_tpu.checkpointing",
     "PGTransport": "torchft_tpu.checkpointing",
+    "DurableCheckpointer": "torchft_tpu.checkpointing",
     "LighthouseServer": "torchft_tpu.coordination",
     "LighthouseClient": "torchft_tpu.coordination",
     "ManagerServer": "torchft_tpu.coordination",

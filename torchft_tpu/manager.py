@@ -262,9 +262,11 @@ class Manager:
         )
         # (executor future, staged future) pairs still in flight: shutdown
         # must fail the staged futures of cancelled tasks or their waiters
-        # stall for the full timeout
+        # stall for the full timeout. Guarded together with the shutdown
+        # flag so a submit can't race the shutdown sweep.
         self._staged_pending: List[Any] = []
         self._staged_lock = threading.Lock()
+        self._staging_down = False
         self._quorum_future: Optional[Any] = None
 
         self._logger = _ManagerLogger(self, self._replica_id, group_rank)
@@ -622,12 +624,27 @@ class Manager:
                         except RuntimeError:
                             pass
 
-                exec_fut = self._staging_executor.submit(stage)
+                # submit + register atomically vs the shutdown sweep: a pair
+                # appended after the sweep would never have its staged
+                # future failed (full-timeout stall), and a submit after
+                # executor shutdown raises anyway
                 with self._staged_lock:
-                    self._staged_pending = [
-                        p for p in self._staged_pending if not p[1].done()
-                    ]
-                    self._staged_pending.append((exec_fut, staged_fut))
+                    if self._staging_down:
+                        raise RuntimeError("manager is shut down")
+                    exec_fut = self._staging_executor.submit(stage)
+                    pair = (exec_fut, staged_fut)
+                    self._staged_pending.append(pair)
+
+                def _unpin(_f: Future) -> None:
+                    # release the (gradient-sized) result reference as soon
+                    # as the wire resolves, not at the next allreduce
+                    with self._staged_lock:
+                        try:
+                            self._staged_pending.remove(pair)
+                        except ValueError:
+                            pass
+
+                staged_fut.add_done_callback(_unpin)
 
             fut = fut.then(normalize)
             fut = self.wrap_future(fut, zeros())
@@ -845,6 +862,8 @@ class Manager:
         # pg.shutdown below, spuriously reporting errors on a torn-down
         # manager — and fail their staged futures so any waiter unblocks
         # immediately instead of riding out the full timeout
+        with self._staged_lock:
+            self._staging_down = True
         self._staging_executor.shutdown(wait=wait, cancel_futures=not wait)
         with self._staged_lock:
             pending, self._staged_pending = self._staged_pending, []

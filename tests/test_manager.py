@@ -165,6 +165,60 @@ class TestQuorumHappyPath:
         np.testing.assert_allclose(out["w"], 0.0)
         first.get_future().wait(timeout=10)
 
+    def test_host_staging_survives_buffer_donation(self):
+        """The staging thread reads the gradients after allreduce() returns;
+        a caller donating its buffers in the next jitted step must not turn
+        the contribution into an error/zeros (regression: staging captured
+        the caller's buffers instead of private copies)."""
+        import threading
+        import jax
+        import jax.numpy as jnp
+
+        from torchft_tpu.process_group import ProcessGroup
+        from torchft_tpu.work import DummyWork
+
+        gate = threading.Event()
+
+        class GatedPG(ProcessGroup):
+            def configure(self, *a, **k):
+                pass
+
+            def allreduce(self, arrays, op=ReduceOp.SUM):
+                gate.wait(5)  # hold the op until the caller donated
+                return DummyWork([np.asarray(a) for a in arrays])
+
+            def errored(self):
+                return None
+
+            def abort(self):
+                pass
+
+            def shutdown(self):
+                gate.set()
+
+            def size(self):
+                return 1
+
+            def rank(self):
+                return 0
+
+            def allgather(self, arrays):  # pragma: no cover - unused
+                raise NotImplementedError
+
+            broadcast = reduce_scatter = alltoall = send = recv = allgather
+
+        m = make_manager(pg=GatedPG(), quorum=make_quorum())
+        m.start_quorum()
+        grads = {"w": jnp.full((4,), 4.0, jnp.float32)}
+        work = m.allreduce(grads)
+        # donate the gradient buffers before the wire runs
+        jax.jit(lambda p: jax.tree_util.tree_map(lambda x: x * 0, p),
+                donate_argnums=(0,))(grads)
+        gate.set()
+        out = work.get_future().wait(timeout=10)
+        assert m.errored() is None
+        np.testing.assert_allclose(np.asarray(out["w"]), 2.0)  # 4 / 2
+
     def test_allreduce_sum_no_normalize(self):
         m = make_manager(quorum=make_quorum())
         m.start_quorum()

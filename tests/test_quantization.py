@@ -17,6 +17,29 @@ from torchft_tpu.ops.quantization import (
 from torchft_tpu.process_group import ProcessGroupHost, ReduceOp
 
 
+def test_sharded_leaves_take_host_path():
+    """Mesh-sharded pseudogradients (fsdp-sharded DiLoCo under --quantize)
+    must not hit the eager Pallas kernels — no SPMD partitioning rule —
+    and instead go through the host engine (regression)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.collectives import _is_device_tree
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 (virtual) devices")
+    mesh = Mesh(np.array(devs[:2]), ("x",))
+    sharded = jax.device_put(
+        jnp.arange(8, dtype=jnp.float32), NamedSharding(mesh, P("x"))
+    )
+    single = jnp.arange(8, dtype=jnp.float32)
+    assert _is_device_tree([single])
+    assert not _is_device_tree([sharded])
+    assert not _is_device_tree([single, sharded])
+
+
 class TestRowwiseFp8:
     def test_roundtrip_error_bounded(self):
         rng = np.random.RandomState(0)

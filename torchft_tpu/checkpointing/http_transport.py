@@ -65,10 +65,13 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._state_lock.w_acquire()
         self._have_state = False
 
-        self._step: Optional[int] = None
-        self._spec: Optional[TreeSpecPayload] = None
-        self._payloads: Optional[List[Any]] = None  # staged host arrays/bytes
-        self._assignments: Optional[List[List[int]]] = None  # chunk -> leaves
+        # One atomic snapshot per staging: (step, spec, payloads,
+        # assignments). Handlers capture the reference ONCE per request, so
+        # a restage mid-stream keeps serving the old snapshot consistently
+        # instead of mixing two steps' leaves into one body (restaging swaps
+        # a single attribute; the old snapshot's references stay alive for
+        # in-flight readers).
+        self._staged: Optional[tuple] = None
 
         # Delivery tracking: how many chunk fetches we expect for the staged
         # step vs. how many were served. disallow_checkpoint() grants a grace
@@ -114,14 +117,18 @@ class HTTPTransport(CheckpointTransport[Any]):
                     try:
                         # the read lock is held across the whole streamed
                         # write: disallow_checkpoint cannot yank the staged
-                        # arrays out from under an in-flight response
-                        if transport._step != step:
+                        # arrays out from under an in-flight response. The
+                        # snapshot is captured once — restaging swaps the
+                        # attribute atomically and cannot tear this body.
+                        staged = transport._staged
+                        if staged is None or staged[0] != step:
+                            have = staged[0] if staged else None
                             self.send_error(
                                 400,
-                                f"serving step {transport._step}, asked {step}",
+                                f"serving step {have}, asked {step}",
                             )
                             return
-                        if not transport._stream_response(self, what):
+                        if not transport._stream_response(self, staged, what):
                             self.send_error(404, f"unknown resource {what}")
                             return
                     except (BrokenPipeError, TimeoutError, OSError):
@@ -149,16 +156,16 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._serve_thread.start()
 
     # -- serving side -----------------------------------------------------
-    def _stream_response(self, handler: Any, what: str) -> bool:
-        """Write the response for ``what`` (True if the resource exists).
+    def _stream_response(self, handler: Any, staged: tuple, what: str) -> bool:
+        """Write the response for ``what`` (True if the resource exists)
+        from the captured ``staged`` snapshot.
 
         Chunk bodies stream straight from the staged arrays: per leaf a
         16-byte [leaf_idx, nbytes] frame then the raw buffer — never
         assembled in memory."""
-        assert self._spec is not None
-        assert self._payloads is not None and self._assignments is not None
+        _step, spec, payloads, assignments = staged
         if what == "metadata":
-            body = pickle.dumps((self._spec, len(self._assignments)))
+            body = pickle.dumps((spec, len(assignments)))
             handler.send_response(200)
             handler.send_header("Content-Type", "application/octet-stream")
             handler.send_header("Content-Length", str(len(body)))
@@ -167,23 +174,28 @@ class HTTPTransport(CheckpointTransport[Any]):
             return True
         if what.startswith("chunk_"):
             i = int(what[len("chunk_"):])
-            if not (0 <= i < len(self._assignments)):
+            if not (0 <= i < len(assignments)):
                 return False
-            idxs = self._assignments[i]
+            idxs = assignments[i]
             total = sum(
-                _FRAME.size + self._spec.leaves[j].nbytes for j in idxs
+                _FRAME.size + spec.leaves[j].nbytes for j in idxs
             )
             handler.send_response(200)
             handler.send_header("Content-Type", "application/octet-stream")
             handler.send_header("Content-Length", str(total))
             handler.end_headers()
             for j in idxs:
-                mv = payload_memoryview(self._payloads[j])
+                mv = payload_memoryview(payloads[j])
                 handler.wfile.write(_FRAME.pack(j, len(mv)))
                 handler.wfile.write(mv)
             with self._fetch_cond:
-                self._served_fetches += 1
-                self._fetch_cond.notify_all()
+                # only count serves of the CURRENT staging: a stale-snapshot
+                # serve completing after a restage must not satisfy the new
+                # staging's grace window before its receivers have fetched
+                current = self._staged
+                if current is not None and current[0] == _step:
+                    self._served_fetches += 1
+                    self._fetch_cond.notify_all()
             return True
         return False
 
@@ -203,10 +215,8 @@ class HTTPTransport(CheckpointTransport[Any]):
         spec, payloads = flatten_state(state_dict)
         num = self._num_chunks or 1
         assignments = split_chunks([m.nbytes for m in spec.leaves], num)
-        self._step = step
-        self._spec = spec
-        self._payloads = payloads
-        self._assignments = assignments
+        # single atomic swap: in-flight readers keep the old snapshot
+        self._staged = (step, spec, payloads, assignments)
         with self._fetch_cond:
             self._expected_fetches = len(assignments) * max(len(dst_ranks), 0)
             self._served_fetches = 0
@@ -229,10 +239,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     "timed out waiting for in-flight checkpoint reads to finish"
                 )
             self._have_state = False
-            self._spec = None
-            self._payloads = None
-            self._assignments = None
-            self._step = None
+            self._staged = None
 
     # -- receiving side ---------------------------------------------------
     def recv_checkpoint(self, src_rank: int, metadata: str, step: int, timeout) -> Any:

@@ -67,11 +67,16 @@ class PGTransport(CheckpointTransport[Any]):
             self._pg.send([np.frombuffer(header, dtype=np.uint8)], dst, tag=1).wait(
                 self._timeout
             )
-            # Pipelined tagged sends: up to SEND_WINDOW leaves in flight so
-            # serialization of leaf k overlaps the wire time of leaf k-1
-            # (the reference's sequential-send weakness, pg_transport.py:
-            # 202-233, was a full wait per leaf). Leaves ship as uint8
-            # views of the staged host arrays — no serialization copy here.
+            # Windowed sends: keep at most SEND_WINDOW leaves in flight.
+            # The window is not about caller overlap (leaves ship as
+            # zero-copy uint8 views; a direct ProcessGroupHost serializes
+            # the wire on its one worker regardless) — it is BACKPRESSURE:
+            # with a ProcessGroupBaby recovery PG each in-flight send is a
+            # pickled full-leaf copy buffered in the child process, and an
+            # unbounded issue loop would materialize a checkpoint-sized
+            # pile of copies there (12GB-class state dicts → host OOM
+            # during healing). The reference's per-leaf blocking wait
+            # (pg_transport.py:202-233) is the window=1 special case.
             pending: List[Any] = []
             for buf in payloads:
                 wire = (

@@ -48,9 +48,21 @@ def _ceil_div(a: int, b: int) -> int:
 
 
 def _is_device_tree(arrays: Sequence[Any]) -> bool:
+    """True iff every leaf is a single-device jax.Array.
+
+    Mesh-sharded leaves (NamedSharding over >1 device — e.g. fsdp-sharded
+    DiLoCo pseudogradients) must take the host path: the eager Pallas
+    quantize calls have no SPMD partitioning rule, so running them on a
+    sharded array would either fail to lower or force a full gather onto
+    one device. The host path's np.asarray performs the same gather but
+    into host RAM, where the wire needs the bytes anyway.
+    """
     import jax
 
-    return bool(arrays) and all(isinstance(a, jax.Array) for a in arrays)
+    return bool(arrays) and all(
+        isinstance(a, jax.Array) and len(a.sharding.device_set) == 1
+        for a in arrays
+    )
 
 
 def _flatten(arrays: Sequence[Any]) -> tuple[np.ndarray, List[tuple], List[np.dtype]]:

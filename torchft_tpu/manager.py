@@ -585,6 +585,28 @@ class Manager:
                 fut = staged_fut
                 participating = self.is_participating()
 
+                # Capture on the caller thread: the staging thread reads
+                # these AFTER allreduce() returns, by which time the
+                # caller's next jitted step may have donated (deleted) the
+                # device buffers or overwritten a reused numpy buffer.
+                # jax.Arrays get a device-side copy (HBM bandwidth, async
+                # dispatch — far cheaper than blocking the train loop on
+                # the D2H transfer); numpy leaves get a host memcpy.
+                # Non-participants skip the capture entirely — they
+                # contribute zeros built from shapes alone (the reference
+                # zeroes the buffer in place; arrays are immutable here).
+                import jax.numpy as jnp
+
+                if participating:
+                    capture = [
+                        jnp.copy(l) if isinstance(l, jax.Array)
+                        else np.array(l, copy=True)
+                        for l in leaves
+                    ]
+                else:
+                    capture = None
+                zero_specs = [(np.shape(l), _np_dtype(l)) for l in leaves]
+
                 def stage() -> None:
                     """D2H + dispatch only — the PG's own ordered worker
                     runs the wire, and the result chains in via callback.
@@ -592,12 +614,12 @@ class Manager:
                     this one thread and charge queue time against later
                     calls' wrap_future timeouts."""
                     try:
-                        host_leaves = [np.asarray(l) for l in leaves]
-                        if not participating:
-                            # Spares / healing replicas contribute zeros
-                            # (reference zeroes the buffer in place; arrays
-                            # are immutable here so we swap values).
-                            host_leaves = [np.zeros_like(h) for h in host_leaves]
+                        if capture is None:
+                            host_leaves = [
+                                np.zeros(s, d) for s, d in zero_specs
+                            ]
+                        else:
+                            host_leaves = [np.asarray(l) for l in capture]
                         if should_quantize:
                             from torchft_tpu.collectives import allreduce_quantized
 
@@ -776,12 +798,13 @@ class Manager:
         self._batches_committed = state_dict["batches_committed"]
 
     def _manager_state_dict(self) -> Dict[str, Any]:
-        with self._state_dict_lock.r_lock():
-            assert len(self._user_state_dicts) > 0, "user state_dict is not initialized"
-            return {
-                "user": {key: fn() for key, fn in self._user_state_dicts.items()},
-                "torchft": self.state_dict(),
-            }
+        assert len(self._user_state_dicts) > 0, "user state_dict is not initialized"
+        # one source of truth for the user-state composition: live healing
+        # and durable checkpoints must capture the same composite
+        return {
+            "user": self.user_state_dict(),
+            "torchft": self.state_dict(),
+        }
 
     def state_dict(self) -> Dict[str, int]:
         """Manager state for durable checkpoints: include this in your own

@@ -145,6 +145,36 @@ class TestSplashAttention:
         assert np.isfinite(np.asarray(g)).all()
 
 
+class TestSplashInModel:
+    def test_llama_fwd_bwd_matches_xla(self):
+        """End-to-end: the GQA llama layer stack through the splash kernel
+        (interpret) equals the XLA reference, loss and gradients."""
+        import dataclasses
+
+        from torchft_tpu.models.llama import CONFIGS, llama_init, llama_loss
+        from torchft_tpu.ops.attention import splash_attention_tpu
+
+        cfg = dataclasses.replace(
+            CONFIGS["debug"], dim=512, n_heads=4, n_kv_heads=2,
+            n_layers=1, dtype=jnp.float32,
+        )  # head_dim 128: the splash tile minimum
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (1, 128), 0, cfg.vocab_size
+        )
+        splash = lambda q, k, v, c: splash_attention_tpu(  # noqa: E731
+            q, k, v, c, interpret=True)
+        l_splash = float(llama_loss(params, toks, toks, cfg,
+                                    attention_fn=splash))
+        l_ref = float(llama_loss(params, toks, toks, cfg))
+        assert abs(l_splash - l_ref) < 1e-3, (l_splash, l_ref)
+        g = jax.grad(
+            lambda p: llama_loss(p, toks, toks, cfg, attention_fn=splash)
+        )(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+
+
 class TestDispatch:
     def test_cpu_falls_back_to_xla(self):
         if jax.default_backend() != "cpu":

@@ -25,7 +25,7 @@ def test_sharded_leaves_take_host_path():
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from torchft_tpu.collectives import _is_device_tree
+    from torchft_tpu.collectives import is_device_tree
 
     devs = jax.devices()
     if len(devs) < 2:
@@ -35,9 +35,9 @@ def test_sharded_leaves_take_host_path():
         jnp.arange(8, dtype=jnp.float32), NamedSharding(mesh, P("x"))
     )
     single = jnp.arange(8, dtype=jnp.float32)
-    assert _is_device_tree([single])
-    assert not _is_device_tree([sharded])
-    assert not _is_device_tree([single, sharded])
+    assert is_device_tree([single])
+    assert not is_device_tree([sharded])
+    assert not is_device_tree([single, sharded])
 
 
 class TestRowwiseFp8:

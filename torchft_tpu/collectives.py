@@ -38,7 +38,7 @@ from torchft_tpu.ops.quantization import (
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import Future, FutureWork, Work
 
-__all__ = ["allreduce_quantized", "reduce_scatter_quantized"]
+__all__ = ["allreduce_quantized", "is_device_tree", "reduce_scatter_quantized"]
 
 _ROW = 512
 
@@ -47,7 +47,7 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _is_device_tree(arrays: Sequence[Any]) -> bool:
+def is_device_tree(arrays: Sequence[Any]) -> bool:
     """True iff every leaf is a single-device jax.Array.
 
     Mesh-sharded leaves (NamedSharding over >1 device — e.g. fsdp-sharded
@@ -230,7 +230,7 @@ def allreduce_quantized(
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
 
-    if _is_device_tree(arrays):
+    if is_device_tree(arrays):
         dflat, dshapes, ddtypes = _flatten_jax(arrays)
 
         def run_device() -> List[Any]:
@@ -284,3 +284,7 @@ def reduce_scatter_quantized(
         return acc
 
     return _run_async(run)
+
+
+# backward-compat private alias
+_is_device_tree = is_device_tree

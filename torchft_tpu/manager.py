@@ -539,14 +539,14 @@ class Manager:
         # the Pallas kernels quantize there and only the compressed payload
         # crosses to the host wire (collectives.py). Host-plane PGs with
         # plain numpy inputs get the numpy staging they require.
-        from torchft_tpu.collectives import _is_device_tree
+        from torchft_tpu.collectives import is_device_tree
 
         # quantized leaves only count as device-native when the Pallas
         # kernels can actually run on them (single-device arrays; the same
         # predicate collectives.py uses) — a mesh-sharded tree must take
         # the staged host path, not a caller-thread cross-device gather
         device_native = getattr(self._pg, "device_native", False) or (
-            should_quantize and _is_device_tree(leaves)
+            should_quantize and is_device_tree(leaves)
         )
 
         pg_reduce_op = reduce_op

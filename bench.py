@@ -92,9 +92,10 @@ def _probe_accelerator(timeout_s: float = 240.0) -> str:
     """Report what backend init actually does — probed in a SUBPROCESS.
 
     Returns "accel" (an accelerator initializes), "cpu" (backend init works
-    but only CPU is present — a legitimate dev-box baseline), or "hung"
-    (init never returned: the wedged-TPU-tunnel mode that made round 1's
-    bench emit nothing). Must run before the first jax import/use here.
+    but only CPU is present — a legitimate dev-box baseline), "crash"
+    (backend init fails fast — broken install/driver; stderr is printed),
+    or "hung" (init never returned: the wedged-TPU-tunnel mode that made
+    round 1's bench emit nothing). Must run before any jax import/use here.
     """
     import subprocess
 
@@ -108,17 +109,19 @@ def _probe_accelerator(timeout_s: float = 240.0) -> str:
             return "accel"
         if out.returncode == 0:
             return "cpu"
-        return "hung"
+        print(f"# accelerator probe crashed:\n{out.stderr[-2000:]}",
+              file=sys.stderr)
+        return "crash"
     except subprocess.TimeoutExpired:
         return "hung"
 
 
 def main() -> None:
     probe = _probe_accelerator()
-    if probe == "hung":
-        # backend init would hang this process too; force the CPU platform
-        # so a (degraded, clearly marked) artifact still gets emitted
-        print("# accelerator probe hung; falling back to CPU",
+    if probe in ("hung", "crash"):
+        # backend init would hang/crash this process too; force the CPU
+        # platform so a (degraded, clearly marked) artifact still emits
+        print(f"# accelerator probe {probe}; falling back to CPU",
               file=sys.stderr)
         from torchft_tpu.utils import force_virtual_cpu_devices
 
@@ -178,9 +181,11 @@ def main() -> None:
         # the artifact, not just implied by the requested mode
         "attention_mode": f"{mode}:{_attn.LAST_DISPATCH}",
     }
-    if probe == "hung":
+    if probe in ("hung", "crash"):
         # the number above is a CPU-fallback measurement, not the chip's
-        record["error"] = "accelerator init hung (wedged tunnel?); CPU fallback"
+        detail = ("init hung (wedged tunnel?)" if probe == "hung"
+                  else "init crashed (see stderr)")
+        record["error"] = f"accelerator {detail}; CPU fallback"
 
     # FT metrics ride the same line; a failure here must never cost the
     # headline number.

@@ -129,6 +129,69 @@ class TestHTTPTransport:
             dst.shutdown()
 
 
+class TestHTTPRestageAtomicity:
+    def test_reader_mid_stream_survives_restage(self):
+        """A receiver that started fetching step N must get a CONSISTENT
+        step-N body even if the sender restages step N+1 mid-stream
+        (regression: the handler used to dereference live attributes per
+        frame, mixing two steps' leaves into one response)."""
+        import socket as _socket
+        import struct
+        import urllib.parse
+
+        import numpy as np
+
+        src = HTTPTransport(timeout=10.0)
+        try:
+            # large enough that loopback socket buffers cannot absorb the
+            # whole body (which would let the serve finish before the
+            # restage and make the test vacuous)
+            n = 8_000_000  # 32 MB
+            state_n = {"w": np.full(n, 1.0, np.float32)}
+            state_n1 = {"w": np.full(n, 2.0, np.float32)}
+            src.send_checkpoint([1], step=5, state_dict=state_n, timeout=10.0)
+
+            url = urllib.parse.urlparse(src.metadata())
+            s = _socket.create_connection((url.hostname, url.port), timeout=10)
+            s.sendall(b"GET /checkpoint/5/chunk_0 HTTP/1.1\r\n"
+                      b"Host: x\r\nConnection: close\r\n\r\n")
+            # read headers + a small prefix of the body, then pause
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                got = s.recv(4096)
+                assert got, "server closed before headers"
+                buf += got
+            body = buf.split(b"\r\n\r\n", 1)[1]
+            while len(body) < 4096:
+                got = s.recv(4096)
+                assert got, "server closed mid-body"
+                body += got
+
+            # the serve-complete counter only bumps after the full body is
+            # written; zero proves the stream really is still in flight
+            assert src._served_fetches == 0
+            # restage a different step while the stream is mid-flight
+            src.send_checkpoint([1], step=6, state_dict=state_n1, timeout=10.0)
+
+            while True:
+                got = s.recv(1 << 16)
+                if not got:
+                    break
+                body += got
+            s.close()
+
+            frame = struct.Struct("<qq")
+            leaf_idx, nbytes = frame.unpack(body[: frame.size])
+            assert leaf_idx == 0
+            payload = np.frombuffer(
+                body[frame.size: frame.size + nbytes], np.float32
+            )
+            # every byte must come from step 5's snapshot
+            np.testing.assert_array_equal(payload, state_n["w"])
+        finally:
+            src.shutdown()
+
+
 class TestPGTransport:
     def test_send_recv_over_host_pg(self):
         store = KvStoreServer("127.0.0.1:0")

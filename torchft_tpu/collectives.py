@@ -285,6 +285,3 @@ def reduce_scatter_quantized(
 
     return _run_async(run)
 
-
-# backward-compat private alias
-_is_device_tree = is_device_tree

@@ -80,13 +80,21 @@ def test_pg_abort_dumps(tmp_path, monkeypatch):
         pg.configure(f"127.0.0.1:{store.port}/x", 0, 1)
         pg.allreduce([np.ones(2)]).get_future().wait()
         pg.abort()
-        path = fresh.dump_path()  # pid-tagged default path
-        assert path is not None and path.exists()
-        events = [json.loads(line) for line in path.read_text().splitlines()]
+        # dumps get a unique {pid}_{seq} tag so repeated aborts in one
+        # process never overwrite each other's evidence
+        dump_dir = fresh.dump_path().parent
+        dumps = list(dump_dir.iterdir())
+        assert len(dumps) == 1
+        events = [
+            json.loads(line) for line in dumps[0].read_text().splitlines()
+        ]
         assert any(e["kind"] == "pg_abort" for e in events)
         assert any(
             e["kind"] == "collective" and e["op"] == "allreduce" for e in events
         )
+        # a second abort must land in a NEW file (regression: overwrite)
+        pg.abort()
+        assert len(list(dump_dir.iterdir())) == 2
     finally:
         pg.shutdown()
         store.shutdown()

@@ -401,3 +401,47 @@ class TestManagedProcessGroupRank:
         pg = ManagedProcessGroup(_MgrStub())
         assert pg.rank() == 3
         assert pg.size() == 4
+
+
+class TestP2PDeadlockAndModes:
+    def test_symmetric_large_sends_do_not_deadlock(self, store):
+        """Both ranks send a large payload to each other, then recv — with
+        sends on the dispatch thread this deadlocked on full TCP buffers
+        until the watchdog aborted (regression: p2p rides per-peer writer
+        threads now)."""
+        pgs = make_pgs(store, 2, quorum_id=71)
+        big = np.arange(2_000_000, dtype=np.float32)  # 8 MB >> TCP buffers
+
+        def run(rank):
+            other = 1 - rank
+            send_work = pgs[rank].send([big * (rank + 1)], other, tag=5)
+            out = pgs[rank].recv(other, tag=5).get_future().wait(30)
+            send_work.wait(30)
+            return out[0]
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            outs = list(ex.map(run, range(2)))
+        np.testing.assert_allclose(outs[0], big * 2)
+        np.testing.assert_allclose(outs[1], big * 1)
+        for pg in pgs:
+            pg.shutdown()
+
+    def test_p2p_and_collectives_cannot_mix(self, store):
+        """Frame ordering: p2p writes ride per-peer writer threads while
+        collectives write from the dispatch thread, so one generation
+        must reject the mix."""
+        pgs = make_pgs(store, 2, quorum_id=72)
+
+        def run(rank):
+            other = 1 - rank
+            if rank == 0:
+                pgs[0].send([np.ones(4, np.float32)], other, tag=1)
+            else:
+                pgs[1].recv(other, tag=1).get_future().wait(20)
+            with pytest.raises(RuntimeError, match="cannot mix"):
+                pgs[rank].allreduce([np.ones(2, np.float32)])
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            list(ex.map(run, range(2)))
+        for pg in pgs:
+            pg.shutdown()

@@ -81,6 +81,13 @@ class FlightRecorder:
         path or None when disabled. Never raises (dump runs on failure
         paths)."""
         try:
+            if tag is None:
+                # unique per dump: two aborts in one process (host PG then
+                # baby PG, or two in-process Managers) must not overwrite
+                # each other's postmortem evidence
+                with self._lock:
+                    self._dump_seq = getattr(self, "_dump_seq", 0) + 1
+                    tag = f"{os.getpid()}_{self._dump_seq}"
             path = self.dump_path(quorum_id, tag)
             if path is None:
                 return None

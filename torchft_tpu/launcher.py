@@ -130,7 +130,14 @@ def launch_replica_groups(
                         if p.poll() is None:
                             p.terminate()
                     for p in procs:
-                        p.wait(timeout=30)
+                        try:
+                            p.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            # a straggler trapping SIGTERM must not crash
+                            # the supervisor; escalate like the final
+                            # teardown does
+                            p.kill()
+                            p.wait(timeout=30)
                     if restarts[i] < max_restarts:
                         restarts[i] += 1
                         logger.warning(

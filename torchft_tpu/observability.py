@@ -38,6 +38,13 @@ ERROR_EVENTS = "torchft_errors"
 _otel_providers: Dict[str, Any] = {}
 
 
+def _shutdown_quietly(provider: Any) -> None:
+    try:
+        provider.shutdown()
+    except Exception:  # noqa: BLE001 - exit path must never raise
+        pass
+
+
 def _resource_attributes() -> Dict[str, Any]:
     raw = os.environ.get(OTEL_RESOURCE_ATTRS_ENV)
     if not raw:
@@ -77,6 +84,12 @@ def _maybe_otel_logger(name: str) -> Optional[Any]:
         otel_logger.addHandler(handler)
         otel_logger.propagate = False
         _otel_providers[name] = otel_logger
+        # flush the batch processor at exit: the records that matter most
+        # (the error event right before a fatal exit) are exactly the ones a
+        # never-shut-down BatchLogRecordProcessor would drop
+        import atexit
+
+        atexit.register(lambda: _shutdown_quietly(provider))
         return otel_logger
     except Exception:  # noqa: BLE001 — SDK missing or exporter misconfigured
         _otel_providers[name] = None

@@ -95,6 +95,18 @@ def _reduce_np(op: ReduceOp, bufs: List[np.ndarray]) -> np.ndarray:
     return out
 
 
+def _copy_payload(h: Any) -> Any:
+    """Independent copy of a wire payload: ndarray, or tuple containing
+    ndarrays (the quantized (q, scales, n) format)."""
+    if isinstance(h, np.ndarray):
+        return h.copy()
+    if isinstance(h, tuple):
+        return tuple(
+            x.copy() if isinstance(x, np.ndarray) else x for x in h
+        )
+    return h
+
+
 def _to_host(x: Any) -> Any:
     """Stage a jax.Array (or array-like) to host memory.
 
@@ -297,6 +309,11 @@ class _Comm:
         self.aborted = False
         self._lock = threading.Lock()
         self.peers: Dict[int, socket.socket] = {}
+        # per-peer write serialization: collective writers (dispatch/ring
+        # threads) and async p2p writers must never interleave frames on
+        # one socket
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._p2p_queues: Dict[int, "queue.Queue"] = {}
         # traffic accounting (benchmarks/transport_bench.py asserts the ring
         # path's world-size-independent per-rank bytes from these)
         self.bytes_sent = 0
@@ -333,9 +350,15 @@ class _Comm:
         for _ in range(world - 1 - rank):
             s, _ = listener.accept()
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # accepted sockets need the op timeout too — dialed ones carry
+            # it from create_connection; without this, waits on accepted
+            # sockets are unbounded and set_timeout has nothing to update
+            s.settimeout(timeout)
             tag, peer_rank = pickle.loads(_recv_msg(s))
             assert tag == "hello"
             self.peers[peer_rank] = s
+        for j in self.peers:
+            self._send_locks[j] = threading.Lock()
 
     def settimeout(self, timeout: float) -> None:
         with self._lock:
@@ -347,8 +370,11 @@ class _Comm:
 
     def send_to(self, peer: int, obj: Any) -> None:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        self.bytes_sent += len(payload) + _HDR.size
-        _send_msg(self.peers[peer], payload)
+        with self._send_locks[peer]:
+            _send_msg(self.peers[peer], payload)
+            # counters guarded by the send lock: multiple writer threads
+            # (dispatch, ring, p2p) would race the read-modify-write
+            self.bytes_sent += len(payload) + _HDR.size
 
     def recv_from(self, peer: int) -> Any:
         payload = _recv_msg(self.peers[peer])
@@ -364,9 +390,10 @@ class _Comm:
             buf = buf.reshape(-1).view(np.uint8)  # reshape first: 0-d safe
         mv = memoryview(buf).cast("B")
         sock = self.peers[peer]
-        sock.sendall(_HDR.pack(len(mv)))
-        sock.sendall(mv)
-        self.bytes_sent += len(mv) + _HDR.size
+        with self._send_locks[peer]:
+            sock.sendall(_HDR.pack(len(mv)))
+            sock.sendall(mv)
+            self.bytes_sent += len(mv) + _HDR.size
 
     def recv_raw_into(self, peer: int, out: Any) -> None:
         """Receive one frame directly into a writable buffer (zero staging
@@ -414,9 +441,53 @@ class _Comm:
             raise err[0]
         return out
 
+    def p2p_send_async(self, peer: int, job, fut, fail) -> None:
+        """Run a p2p write job on the per-peer writer thread (strict FIFO
+        per peer) instead of the dispatch thread. Rationale: symmetric
+        send/send between two ranks would block both dispatch threads in
+        sendall on full TCP buffers, and the matching recvs — queued behind
+        them — could never drain (the deadlock the exchange/ring writer
+        threads already guard against)."""
+        import queue as _q
+
+        def _writer(wq: "_q.Queue") -> None:
+            while True:
+                item = wq.get()
+                if item is None:
+                    return
+                jb, ft, fl = item
+                try:
+                    jb()
+                    ft.set_result(None)
+                except BaseException as e:  # noqa: BLE001
+                    err = e if isinstance(e, Exception) else RuntimeError(str(e))
+                    fl(err)
+                    try:
+                        ft.set_exception(err)
+                    except RuntimeError:
+                        pass
+
+        with self._lock:
+            if self.aborted:
+                raise RuntimeError("communicator aborted")
+            q = self._p2p_queues.get(peer)
+            if q is None:
+                q = _q.Queue()
+                self._p2p_queues[peer] = q
+                threading.Thread(
+                    target=_writer, args=(q,), daemon=True,
+                    name=f"pg_host_p2p_r{self.rank}_to{peer}",
+                ).start()
+            # enqueue under the lock: abort() posts its shutdown sentinel
+            # under the same lock, so a job can never land behind the
+            # sentinel and leave its future unresolved
+            q.put((job, fut, fail))
+
     def abort(self) -> None:
         with self._lock:
             self.aborted = True
+            for q in self._p2p_queues.values():
+                q.put(None)
             for s in self.peers.values():
                 try:
                     s.shutdown(socket.SHUT_RDWR)
@@ -551,6 +622,27 @@ class ProcessGroupHost(ProcessGroup):
             self.comm = comm
             self.queue: queue.Queue = queue.Queue()
             self.error: Optional[Exception] = None
+            # "p2p" | "collective" | None — fixed by the first op. p2p
+            # sends ride per-peer writer threads while collectives write
+            # from the dispatch/ring threads; mixing the two on one
+            # generation could reorder frames on a shared socket, so it is
+            # rejected (in-tree usage already splits them: the Manager's PG
+            # does collectives, the recovery PGTransport's PG does p2p).
+            self.mode: Optional[str] = None
+            self.mode_lock = threading.Lock()
+
+        def claim_mode(self, mode: str) -> None:
+            with self.mode_lock:
+                if self.mode is None:
+                    self.mode = mode
+                elif self.mode != mode:
+                    raise RuntimeError(
+                        f"ProcessGroupHost generation already used for "
+                        f"{self.mode} ops; p2p and collective ops cannot "
+                        "mix on one generation (frame ordering) — use a "
+                        "separate PG (the reference uses a dedicated "
+                        "recovery PG for checkpoints too)"
+                    )
 
         def abort(self) -> None:
             if self.error is None:
@@ -588,6 +680,20 @@ class ProcessGroupHost(ProcessGroup):
             daemon=True,
             name=f"pg_host_dispatch_r{replica_rank}",
         ).start()
+
+    def set_timeout(self, timeout) -> None:
+        super().set_timeout(timeout)
+        # reaches the wire: without this only the abort watchdog moves and
+        # the sockets keep their configure-time timeouts (asymmetric
+        # failures: dialed sockets time out, accepted ones never would).
+        # Guarded: the constructor calls set_timeout before _lock exists.
+        lock = getattr(self, "_lock", None)
+        if lock is None:
+            return
+        with lock:
+            gen = self._gen
+        if gen is not None:
+            gen.comm.settimeout(self._timeout)
 
     def abort(self) -> None:
         with self._lock:
@@ -634,15 +740,24 @@ class ProcessGroupHost(ProcessGroup):
             try:
                 # the watchdog aborts THIS generation's mesh only
                 with context_timeout(gen.abort, self._timeout):
-                    fut.set_result(fn(gen.comm))
+                    result = fn(gen.comm)
             except BaseException as e:  # noqa: BLE001
                 gen.error = e if isinstance(e, Exception) else RuntimeError(str(e))
                 try:
                     fut.set_exception(e)
                 except RuntimeError:
                     pass
+            else:
+                # set_result runs chained done-callbacks synchronously;
+                # they must not be charged against the collective's
+                # watchdog (a slow callback would abort a healthy mesh)
+                try:
+                    fut.set_result(result)
+                except RuntimeError:
+                    pass
 
-    def _submit(self, fn: Callable[["_Comm"], Any], name: str = "op") -> Work:
+    def _submit(self, fn: Callable[["_Comm"], Any], name: str = "op",
+                mode: str = "collective") -> Work:
         _fr.recorder.record(
             "collective", op=name, rank=self._rank, world=self._world
         )
@@ -652,6 +767,7 @@ class ProcessGroupHost(ProcessGroup):
                 raise RuntimeError("process group is not configured")
             if gen.error is not None:
                 raise gen.error
+            gen.claim_mode(mode)
             fut: Future[Any] = Future()
             gen.queue.put((fn, fut))
             return FutureWork(fut)
@@ -662,7 +778,11 @@ class ProcessGroupHost(ProcessGroup):
 
         def _run(comm):
             if comm.world == 1:
-                return host if op != ReduceOp.AVG else [h.copy() for h in host]
+                # independent copies: at world >= 2 results never alias the
+                # inputs (the ring/exchange paths allocate), and the
+                # degraded single-replica fleet must honor the same
+                # contract. _copy_payload is tuple-safe (quantized wire).
+                return [_copy_payload(h) for h in host]
             # Large ndarray payloads ride the ring (per-rank traffic ~2x
             # payload, world-size-independent); small or non-ndarray ones
             # (quantized tuples) use the one-round full-mesh exchange.
@@ -684,7 +804,7 @@ class ProcessGroupHost(ProcessGroup):
 
         def _run(comm):
             if comm.world == 1:
-                return [host]
+                return [[_copy_payload(h) for h in host]]
             gathered = comm.exchange(
                 {r: host for r in range(comm.world)}
             )
@@ -737,23 +857,43 @@ class ProcessGroupHost(ProcessGroup):
 
     def send(self, arrays, dst, tag=0):
         host = [_to_host(a) for a in arrays]
+        _fr.recorder.record(
+            "collective", op="send", rank=self._rank, world=self._world
+        )
+        with self._lock:
+            gen = self._gen
+            if gen is None:
+                raise RuntimeError("process group is not configured")
+            if gen.error is not None:
+                raise gen.error
+            gen.claim_mode("p2p")
+        fut: Future[Any] = Future()
+        timeout = self._timeout
 
-        def _run(comm):
-            if all(isinstance(h, np.ndarray) for h in host) and (
-                sum(h.nbytes for h in host) >= _RING_MIN_BYTES
-            ):
-                # raw-frame p2p: a small pickled header with dtype/shape
-                # metas, then each leaf's bytes straight from its memory —
-                # no pickling copy of multi-GB checkpoint leaves
-                metas = [(str(h.dtype), h.shape) for h in host]
-                comm.send_to(dst, ("p2p_raw", tag, metas))
-                for h in host:
-                    comm.send_raw(dst, np.ascontiguousarray(h))
-            else:
-                comm.send_to(dst, ("p2p", tag, host))
-            return None
+        def job() -> None:
+            # own watchdog: the job runs on the per-peer writer thread, not
+            # the dispatch thread (see _Comm.p2p_send_async — symmetric
+            # send/send would deadlock both dispatch threads otherwise)
+            with context_timeout(gen.abort, timeout):
+                comm = gen.comm
+                if all(isinstance(h, np.ndarray) for h in host) and (
+                    sum(h.nbytes for h in host) >= _RING_MIN_BYTES
+                ):
+                    # raw-frame p2p: a small pickled header with dtype/shape
+                    # metas, then each leaf's bytes straight from memory —
+                    # no pickling copy of multi-GB checkpoint leaves
+                    metas = [(str(h.dtype), h.shape) for h in host]
+                    comm.send_to(dst, ("p2p_raw", tag, metas))
+                    for h in host:
+                        comm.send_raw(dst, np.ascontiguousarray(h))
+                else:
+                    comm.send_to(dst, ("p2p", tag, host))
 
-        return self._submit(_run, "send")
+        def fail(e: Exception) -> None:
+            gen.error = gen.error or e
+
+        gen.comm.p2p_send_async(dst, job, fut, fail)
+        return FutureWork(fut)
 
     def recv(self, src, tag=0):
         def _run(comm):
@@ -771,7 +911,7 @@ class ProcessGroupHost(ProcessGroup):
                 out.append(arr)
             return out
 
-        return self._submit(_run, "recv")
+        return self._submit(_run, "recv", mode="p2p")
 
 
 # ---------------------------------------------------------------------------
@@ -1201,7 +1341,8 @@ class _ErrorSwallowingWork(Work):
     """Work whose future errors resolve to a default value instead of raising
     (reference: process_group.py:1137-1173)."""
 
-    def __init__(self, pg: "ErrorSwallowingProcessGroupWrapper", work: Work, default: Any):
+    def __init__(self, pg: "ErrorSwallowingProcessGroupWrapper", work: Work,
+                 default_fn: Callable[[], Any]):
         self._pg = pg
         self._work = work
         self._future: Future[Any] = Future()
@@ -1212,7 +1353,17 @@ class _ErrorSwallowingWork(Work):
                 self._pg.report_error(
                     exc if isinstance(exc, Exception) else RuntimeError(str(exc))
                 )
-                self._future.set_result(default)
+                # default built lazily, only on the error path — and a
+                # default_fn that itself raises (e.g. non-addressable
+                # sharded arrays) must fail the future, not strand it
+                # (Future._invoke swallows callback exceptions)
+                try:
+                    self._future.set_result(default_fn())
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        self._future.set_exception(e)
+                    except RuntimeError:
+                        pass
             else:
                 self._future.set_result(f.value())
 
@@ -1278,40 +1429,58 @@ class ErrorSwallowingProcessGroupWrapper(ProcessGroup):
     def set_timeout(self, timeout) -> None:
         self._pg.set_timeout(timeout)
 
-    def _guard(self, fn: Callable[[], Work], default: Any) -> Work:
+    def _guard(self, fn: Callable[[], Work], default_fn: Callable[[], Any]) -> Work:
+        """``default_fn`` is LAZY: building a swallow default stages the
+        whole payload to host (blocking D2H for device-native trees, and an
+        outright error for non-addressable sharded arrays), so it must only
+        run on the error path — never per healthy op."""
         if self._error is not None:
-            return DummyWork(default)
+            return DummyWork(default_fn())
         try:
-            return _ErrorSwallowingWork(self, fn(), default)
+            return _ErrorSwallowingWork(self, fn(), default_fn)
         except Exception as e:  # noqa: BLE001
             self.report_error(e)
-            return DummyWork(default)
+            return DummyWork(default_fn())
 
     def allreduce(self, arrays, op=ReduceOp.SUM):
-        default = [_to_host(a) for a in arrays]
-        return self._guard(lambda: self._pg.allreduce(arrays, op), default)
+        return self._guard(
+            lambda: self._pg.allreduce(arrays, op),
+            lambda: [_to_host(a) for a in arrays],
+        )
 
     def allgather(self, arrays):
-        default = [[_to_host(a) for a in arrays]]
-        return self._guard(lambda: self._pg.allgather(arrays), default)
+        # contract: one entry per rank (identity rows for every rank)
+        return self._guard(
+            lambda: self._pg.allgather(arrays),
+            lambda: [
+                [_to_host(a) for a in arrays] for _ in range(self._pg.size())
+            ],
+        )
 
     def broadcast(self, arrays, root=0):
-        default = [_to_host(a) for a in arrays]
-        return self._guard(lambda: self._pg.broadcast(arrays, root), default)
+        return self._guard(
+            lambda: self._pg.broadcast(arrays, root),
+            lambda: [_to_host(a) for a in arrays],
+        )
 
     def reduce_scatter(self, input_chunks, op=ReduceOp.SUM):
-        default = [_to_host(a) for a in input_chunks[0]]
-        return self._guard(lambda: self._pg.reduce_scatter(input_chunks, op), default)
+        # identity default = the chunk THIS rank owns, not rank 0's
+        return self._guard(
+            lambda: self._pg.reduce_scatter(input_chunks, op),
+            lambda: [_to_host(a) for a in input_chunks[self._pg.rank()]],
+        )
 
     def alltoall(self, input_chunks):
-        default = [_to_host(a) for a in input_chunks]
-        return self._guard(lambda: self._pg.alltoall(input_chunks), default)
+        return self._guard(
+            lambda: self._pg.alltoall(input_chunks),
+            lambda: [_to_host(a) for a in input_chunks],
+        )
 
     def send(self, arrays, dst, tag=0):
-        return self._guard(lambda: self._pg.send(arrays, dst, tag), None)
+        return self._guard(lambda: self._pg.send(arrays, dst, tag), lambda: None)
 
     def recv(self, src, tag=0):
-        return self._guard(lambda: self._pg.recv(src, tag), None)
+        return self._guard(lambda: self._pg.recv(src, tag), lambda: None)
 
 
 class FakeProcessGroupWrapper(ProcessGroup):

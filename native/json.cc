@@ -184,13 +184,28 @@ class Parser {
           case 'u': {
             unsigned cp = hex4();
             if (cp >= 0xD800 && cp <= 0xDBFF) {
-              // surrogate pair
+              // surrogate pair: the low half must actually be a low
+              // surrogate — (lo - 0xDC00) on an arbitrary \u escape
+              // underflows unsigned and emits garbage; a lone high
+              // surrogate is not encodable as UTF-8 at all. Invalid
+              // sequences become U+FFFD (replacement), matching what
+              // Python's errors='replace' would do downstream anyway.
               if (pos_ + 1 < s_.size() && s_[pos_] == '\\' &&
                   s_[pos_ + 1] == 'u') {
+                size_t save = pos_;
                 pos_ += 2;
                 unsigned lo = hex4();
-                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                } else {
+                  pos_ = save;  // leave the escape for the main loop
+                  cp = 0xFFFD;
+                }
+              } else {
+                cp = 0xFFFD;
               }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              cp = 0xFFFD;  // lone low surrogate
             }
             append_utf8(cp, out);
             break;

@@ -52,7 +52,20 @@ Json KvStoreServer::handle(const std::string& method, const Json& params,
     std::lock_guard<std::mutex> lk(mu_);
     int64_t cur = 0;
     auto it = data_.find(key);
-    if (it != data_.end()) cur = std::stoll(it->second);
+    if (it != data_.end()) {
+      try {
+        size_t used = 0;
+        cur = std::stoll(it->second, &used);
+        if (used != it->second.size())
+          throw std::invalid_argument("trailing");
+      } catch (const std::exception&) {
+        // a clear error beats an opaque stoll crash: set() and add() share
+        // one namespace but their value formats do not mix
+        throw RpcError("invalid",
+                       "add on key '" + key + "' whose value is not a "
+                       "counter (was it written by set()?)");
+      }
+    }
     cur += amount;
     data_[key] = std::to_string(cur);
     cv_.notify_all();

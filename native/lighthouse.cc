@@ -161,6 +161,11 @@ Json Lighthouse::rpc_quorum(const Json& params, TimePoint deadline) {
     log_info("Replica " + requester.replica_id + " not in quorum, retrying");
     state_.participants[requester.replica_id] =
         MemberDetails{Clock::now(), requester};
+    // refresh the implicit heartbeat like the initial join does: a
+    // directly-connected client (no separate beat loop) whose heartbeat
+    // expired mid-wait would otherwise be excluded as unhealthy on every
+    // retry and spin until its deadline
+    state_.heartbeats[requester.replica_id] = Clock::now();
   }
 }
 
@@ -223,7 +228,7 @@ std::string Lighthouse::status_html() {
 }
 
 std::tuple<std::string, std::string, std::string> Lighthouse::handle_http(
-    const std::string& /*method*/, const std::string& path) {
+    const std::string& method, const std::string& path) {
   try {
     if (path == "/" || path == "/index.html")
       return {"200 OK", "text/html", status_html()};
@@ -231,6 +236,10 @@ std::tuple<std::string, std::string, std::string> Lighthouse::handle_http(
     // POST /replica/{id}/kill — forward a Kill RPC to that replica's manager.
     const std::string prefix = "/replica/";
     if (path.rfind(prefix, 0) == 0 && path.size() > prefix.size()) {
+      // destructive endpoint: POST only — a GET (browser prefetch, crawler
+      // walking the dashboard links) must never kill a replica
+      if (method != "POST")
+        return {"405 Method Not Allowed", "text/plain", "kill requires POST\n"};
       auto rest = path.substr(prefix.size());
       auto slash = rest.find('/');
       if (slash != std::string::npos && rest.substr(slash) == "/kill") {

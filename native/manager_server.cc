@@ -53,7 +53,12 @@ void ManagerServer::heartbeat_loop() {
     try {
       Json params = Json::object();
       params["replica_id"] = opts_.replica_id;
-      heartbeat_client_->call("heartbeat", params, Millis(opts_.connect_timeout_ms));
+      // Short per-beat timeout: the loop is serial, so one RPC stalling for
+      // the full connect timeout (default 10s) would starve the beat past
+      // the lighthouse's 5s expiry and get a LIVE replica evicted. 2s keeps
+      // several retries inside the expiry window.
+      int64_t beat_ms = std::min<int64_t>(opts_.connect_timeout_ms, 2000);
+      heartbeat_client_->call("heartbeat", params, Millis(beat_ms));
     } catch (const std::exception& e) {
       log_info(opts_.replica_id,
                std::string("failed to send heartbeat to lighthouse: ") + e.what());
@@ -151,6 +156,19 @@ Json ManagerServer::rpc_quorum(const Json& params, TimePoint deadline) {
 
     if (static_cast<int64_t>(participants_.size()) == opts_.world_size &&
         running_.load()) {
+      // Aggregate the replica's member across ALL group ranks before
+      // forwarding: the last joiner's view alone would drop another rank's
+      // commit_failures (no quorum bump -> poisoned communicator reused)
+      // or shrink_only request, and overstate step if ranks disagree.
+      QuorumMember agg = member;
+      agg.data.clear();
+      for (const auto& [r, m] : participants_) {  // std::map: rank order
+        agg.step = std::min(agg.step, m.step);
+        agg.commit_failures = std::max(agg.commit_failures, m.commit_failures);
+        agg.shrink_only = agg.shrink_only || m.shrink_only;
+        // deterministic: the lowest rank's non-empty data wins
+        if (agg.data.empty() && !m.data.empty()) agg.data = m.data;
+      }
       participants_.clear();
       Millis timeout(std::max<int64_t>(ms_until(deadline), 1));
       // Reap workers from completed rounds before spawning the next.
@@ -164,8 +182,8 @@ Json ManagerServer::rpc_quorum(const Json& params, TimePoint deadline) {
       }
       auto slot = std::make_unique<WorkerSlot>();
       WorkerSlot* slot_ptr = slot.get();
-      slot_ptr->thread = std::thread([this, member, timeout, slot_ptr] {
-        run_lighthouse_quorum(member, timeout);
+      slot_ptr->thread = std::thread([this, agg, timeout, slot_ptr] {
+        run_lighthouse_quorum(agg, timeout);
         slot_ptr->done.store(true);
       });
       quorum_workers_.push_back(std::move(slot));
@@ -201,6 +219,7 @@ Json ManagerServer::rpc_checkpoint_metadata(const Json& params) {
 
 Json ManagerServer::rpc_should_commit(const Json& params, TimePoint deadline) {
   int64_t group_rank = params.get("group_rank").as_int();
+  int64_t step = params.get_or("step", Json(int64_t(0))).as_int();
   bool should_commit = params.get("should_commit").as_bool();
 
   log_info(opts_.replica_id,
@@ -208,30 +227,44 @@ Json ManagerServer::rpc_should_commit(const Json& params, TimePoint deadline) {
                " should_commit=" + (should_commit ? "true" : "false"));
 
   std::unique_lock<std::mutex> lk(mu_);
-  if (!should_commit) commit_failures_.insert(group_rank);
-  commit_votes_.insert(group_rank);
-  uint64_t waiting_gen = commit_gen_;
-
-  if (static_cast<int64_t>(commit_votes_.size()) == opts_.world_size) {
-    commit_decision_ = commit_failures_.empty();
-    log_info(opts_.replica_id,
-             std::string("should_commit completed should_commit=") +
-                 (commit_decision_ ? "true" : "false"));
-    commit_votes_.clear();
-    commit_failures_.clear();
-    commit_gen_ += 1;
-    commit_cv_.notify_all();
-  } else {
-    bool got = commit_cv_.wait_until(lk, deadline, [&] {
-      return !running_.load() || commit_gen_ > waiting_gen;
-    });
-    if (!running_.load())
-      throw RpcError("unavailable", "manager shutting down");
-    if (!got) throw TimeoutError("should_commit timed out waiting for votes");
+  CommitRound& round = commit_rounds_[step];
+  if (round.decided) {
+    // A failed commit does not advance the step: the group re-votes the
+    // SAME step after requorum. A decided round already holds every
+    // rank's vote, so a new vote can only mean a retry round — reset.
+    round = CommitRound{};
+  }
+  if (!round.decided) {
+    if (!should_commit) round.fails.insert(group_rank);
+    round.votes.insert(group_rank);
+    if (static_cast<int64_t>(round.votes.size()) == opts_.world_size) {
+      round.decided = true;
+      round.decision = round.fails.empty();
+      log_info(opts_.replica_id,
+               std::string("should_commit completed should_commit=") +
+                   (round.decision ? "true" : "false"));
+      // prune decided rounds older than this step (bounded memory; a
+      // straggler re-asking about a pruned step re-creates an empty round
+      // and times out, which is the correct answer for ancient steps)
+      for (auto it = commit_rounds_.begin(); it != commit_rounds_.end();) {
+        if (it->first < step && it->second.decided)
+          it = commit_rounds_.erase(it);
+        else
+          ++it;
+      }
+      commit_cv_.notify_all();
+    } else {
+      bool got = commit_cv_.wait_until(lk, deadline, [&] {
+        return !running_.load() || commit_rounds_[step].decided;
+      });
+      if (!running_.load())
+        throw RpcError("unavailable", "manager shutting down");
+      if (!got) throw TimeoutError("should_commit timed out waiting for votes");
+    }
   }
 
   Json j = Json::object();
-  j["should_commit"] = commit_decision_;
+  j["should_commit"] = commit_rounds_[step].decision;
   return j;
 }
 

@@ -63,12 +63,16 @@ class ManagerServer {
   // Per-rank checkpoint metadata (healing peers fetch these).
   std::map<int64_t, std::string> checkpoint_metadata_;
 
-  // 2-phase commit vote.
+  // 2-phase commit vote, keyed by step: votes from a timed-out or earlier
+  // round must never complete (or veto) a later step's round.
+  struct CommitRound {
+    std::set<int64_t> votes;
+    std::set<int64_t> fails;
+    bool decided = false;
+    bool decision = false;
+  };
   std::condition_variable commit_cv_;
-  std::set<int64_t> commit_votes_;
-  std::set<int64_t> commit_failures_;
-  uint64_t commit_gen_ = 0;
-  bool commit_decision_ = false;
+  std::map<int64_t, CommitRound> commit_rounds_;
 
   std::atomic<bool> running_{true};
   std::unique_ptr<RpcServer> server_;

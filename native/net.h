@@ -31,6 +31,10 @@ class Socket {
   int fd() const { return fd_; }
   bool valid() const { return fd_ >= 0; }
   void close();
+  // Wake any thread blocked in recv/send on this socket WITHOUT freeing the
+  // fd: safe to call from another thread (close() would race the user and
+  // the freed fd number could be reallocated mid-syscall).
+  void shutdown_rdwr();
 
   // All throw std::runtime_error on failure; timeout errors contain "timed out".
   void send_all(const void* data, size_t len, TimePoint deadline);

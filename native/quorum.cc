@@ -118,10 +118,16 @@ std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
     }
   }
 
-  if (static_cast<int64_t>(healthy_participants.size()) < opts.min_replicas) {
+  // min_replicas applies to the PUBLISHABLE candidate list: under
+  // shrink_only the candidates were filtered to previous-quorum members,
+  // and a quorum below min_replicas must not be published just because the
+  // unfiltered healthy count passed. (The majority guard below stays on
+  // the unfiltered health counts, matching the reference — shrink_only
+  // excludes new joiners by design, and they must not veto the shrink.)
+  if (static_cast<int64_t>(candidates.size()) < opts.min_replicas) {
     return {std::nullopt,
             "New quorum not ready, only have " +
-                std::to_string(healthy_participants.size()) +
+                std::to_string(candidates.size()) +
                 " participants, need min_replicas " +
                 std::to_string(opts.min_replicas) + " " + metadata};
   }

@@ -48,7 +48,11 @@ void RpcServer::shutdown() {
   listener_->shutdown();
   {
     std::lock_guard<std::mutex> lk(conn_mu_);
-    for (auto& c : conns_) c->close();
+    // shutdown (not close) from this thread: it wakes any blocked recv/send
+    // in the conn thread, which then exits and closes its own fd. Closing
+    // here would race the conn thread's use of the fd number — a freed fd
+    // can be reallocated to an unrelated file and corrupted.
+    for (auto& c : conns_) c->shutdown_rdwr();
   }
   if (accept_thread_.joinable()) accept_thread_.join();
   std::vector<std::unique_ptr<ConnSlot>> slots;
@@ -236,7 +240,17 @@ Json RpcClient::call(const std::string& method, const Json& params,
       // Reconnect-and-retry only a *stale* cached connection (closed/reset by
       // a restarted or idle-timing-out server). Timeouts and fresh-connection
       // failures don't retry — the request may already have been processed.
-      if (!reused || timed_out) throw;
+      // Only KNOWN-idempotent methods retry (whitelist fails safe; a
+      // blacklist fails open for future mutating RPCs): a reply lost after
+      // the server applied the request re-executes it — "add" would
+      // double-increment rendezvous counters, and "should_commit" would
+      // reset a decided vote round into a divergent 2PC outcome.
+      bool idempotent = method == "get" || method == "wait" ||
+                        method == "heartbeat" || method == "quorum" ||
+                        method == "checkpoint_metadata" ||
+                        method == "status" || method == "set" ||
+                        method == "kill";
+      if (!reused || timed_out || !idempotent) throw;
       cached_ = dial(timeout);
       return call_on(cached_, method, params, timeout);
     }

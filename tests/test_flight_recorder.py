@@ -46,7 +46,9 @@ def test_dump_per_quorum_path(tmp_path, monkeypatch):
     path = fr.dump(reason="test", quorum_id=7, tag="replica_a_0")
     assert path is not None
     assert path.parent.name == "fr_quorum_7"
-    assert path.name == "replica_a_0"
+    # every dump gets a unique sequence suffix so repeated dumps with the
+    # same tag never overwrite each other
+    assert path.name.startswith("replica_a_0_")
     events = [json.loads(line) for line in path.read_text().splitlines()]
     kinds = [e["kind"] for e in events]
     assert kinds == ["quorum_reconfigure", "collective", "dump"]

@@ -81,13 +81,14 @@ class FlightRecorder:
         path or None when disabled. Never raises (dump runs on failure
         paths)."""
         try:
-            if tag is None:
-                # unique per dump: two aborts in one process (host PG then
-                # baby PG, or two in-process Managers) must not overwrite
-                # each other's postmortem evidence
-                with self._lock:
-                    self._dump_seq = getattr(self, "_dump_seq", 0) + 1
-                    tag = f"{os.getpid()}_{self._dump_seq}"
+            # unique per dump — explicit tags included: two dumps with the
+            # same tag in one process (e.g. repeated manager_errors) must
+            # not overwrite each other's postmortem evidence
+            with self._lock:
+                self._dump_seq = getattr(self, "_dump_seq", 0) + 1
+                seq = self._dump_seq
+            base_tag = tag if tag is not None else str(os.getpid())
+            tag = f"{base_tag}_{seq}"
             path = self.dump_path(quorum_id, tag)
             if path is None:
                 return None

@@ -137,7 +137,15 @@ def launch_replica_groups(
                             # the supervisor; escalate like the final
                             # teardown does
                             p.kill()
-                            p.wait(timeout=30)
+                            try:
+                                p.wait(timeout=30)
+                            except subprocess.TimeoutExpired:
+                                # even SIGKILL can stall on D-state I/O;
+                                # carry on supervising rather than dying
+                                logger.warning(
+                                    "worker pid %s unkillable; continuing",
+                                    p.pid,
+                                )
                     if restarts[i] < max_restarts:
                         restarts[i] += 1
                         logger.warning(

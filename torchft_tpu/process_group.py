@@ -817,7 +817,7 @@ class ProcessGroupHost(ProcessGroup):
 
         def _run(comm):
             if comm.world == 1:
-                return host
+                return [_copy_payload(h) for h in host]
             if comm.rank == root:
                 for peer in range(comm.world):
                     if peer != comm.rank:
@@ -832,7 +832,7 @@ class ProcessGroupHost(ProcessGroup):
 
         def _run(comm):
             if comm.world == 1:
-                return host[0]
+                return [_copy_payload(h) for h in host[0]]
             assert len(host) == comm.world, "need one chunk per rank"
             gathered = comm.exchange({r: host[r] for r in range(comm.world)})
             mine = [gathered[r] for r in range(comm.world)]
@@ -848,7 +848,7 @@ class ProcessGroupHost(ProcessGroup):
 
         def _run(comm):
             if comm.world == 1:
-                return host
+                return [_copy_payload(h) for h in host]
             assert len(host) == comm.world, "need one chunk per rank"
             gathered = comm.exchange({r: host[r] for r in range(comm.world)})
             return [gathered[r] for r in range(comm.world)]

@@ -259,7 +259,19 @@ Json ManagerServer::rpc_should_commit(const Json& params, TimePoint deadline) {
       });
       if (!running_.load())
         throw RpcError("unavailable", "manager shutting down");
-      if (!got) throw TimeoutError("should_commit timed out waiting for votes");
+      if (!got) {
+        // withdraw this rank's vote from the abandoned round: leaving it
+        // would let a straggler later complete the round with residue from
+        // an aborted attempt (stale fail vetoing a clean retry, or a
+        // decision this caller never observes)
+        CommitRound& r2 = commit_rounds_[step];
+        if (!r2.decided) {
+          r2.votes.erase(group_rank);
+          r2.fails.erase(group_rank);
+          if (r2.votes.empty()) commit_rounds_.erase(step);
+        }
+        throw TimeoutError("should_commit timed out waiting for votes");
+      }
     }
   }
 

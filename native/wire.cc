@@ -245,8 +245,12 @@ Json RpcClient::call(const std::string& method, const Json& params,
       // the server applied the request re-executes it — "add" would
       // double-increment rendezvous counters, and "should_commit" would
       // reset a decided vote round into a divergent 2PC outcome.
+      // NB: "quorum" is NOT idempotent — the manager's barrier counts
+      // joins, and a re-executed join after a lost reply would offset
+      // every subsequent round by one. (The manager->lighthouse quorum
+      // call has its own application-level retry loop instead.)
       bool idempotent = method == "get" || method == "wait" ||
-                        method == "heartbeat" || method == "quorum" ||
+                        method == "heartbeat" ||
                         method == "checkpoint_metadata" ||
                         method == "status" || method == "set" ||
                         method == "kill";

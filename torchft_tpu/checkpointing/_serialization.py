@@ -33,8 +33,9 @@ __all__ = [
 class TensorMeta:
     """Per-leaf metadata (reference: pg_transport.py:32-59 _TensorMeta).
 
-    ``sharding`` optionally carries a jax.sharding description so the
-    receiver can device_put straight back to the right layout.
+    Layout restore is the receiver's job: transports place received leaves
+    onto a caller-provided template's sharding (PGTransport's in-place
+    receive) rather than shipping sharding descriptions on the wire.
     """
 
     dtype: str
@@ -78,9 +79,14 @@ def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
                 # an alias would tear the checkpoint mid-leaf
                 host = np.array(leaf, copy=True, order="C")
             else:
-                # jax.Array: np.asarray materializes a fresh host buffer
-                # (one D2H, no alias back to trainer state) — zero extra copy
+                # jax.Array: on accelerators np.asarray materializes a
+                # fresh host buffer (one D2H). On the CPU backend it can
+                # be a ZERO-COPY alias of the live device buffer, which a
+                # later donated step may reuse while the serving window is
+                # still streaming — so force ownership when aliased.
                 host = np.ascontiguousarray(np.asarray(leaf))
+                if host.base is not None or not host.flags.owndata:
+                    host = host.copy()
             metas.append(
                 TensorMeta(
                     dtype=str(host.dtype),

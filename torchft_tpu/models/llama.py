@@ -157,22 +157,18 @@ def _attention(
     return causal_attention(q, k, v, cfg)
 
 
-def llama_hidden(
-    params: Dict[str, Any],
-    tokens: jax.Array,
-    cfg: LlamaConfig,
-    attention_fn: Optional[Any] = None,
-    remat: Any = "dots",
-) -> jax.Array:
-    """tokens: int32 [B, S] -> final-norm hidden states [B, S, dim]
-    (everything except the lm_head projection — see `llama_loss`'s chunked
-    path, which applies the head per sequence chunk)."""
+def make_llama_layer_body(
+    cfg: LlamaConfig, attention_fn: Optional[Any] = None
+):
+    """The ONE scanned transformer layer body, shared by every execution
+    path (dense scan here, GPipe stages in parallel/pipeline.py) so the
+    layer math can never diverge between them. Signature matches lax.scan:
+    ``layer(h, layer_params) -> (h, None)`` with h [B, S, dim]."""
     attention = attention_fn or _attention
-    B, S = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-    h = params["embed"][tokens]  # [B,S,D]
 
     def layer(h, layer_params):
+        B, S = h.shape[0], h.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
         x = _rmsnorm(h, layer_params["attn_norm"], cfg.norm_eps)
         q = (x @ layer_params["wq"]).reshape(B, S, cfg.n_heads, cfg.head_dim)
         k = (x @ layer_params["wk"]).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
@@ -188,8 +184,22 @@ def llama_hidden(
         h = h + gated @ layer_params["w_down"]
         return h, None
 
+    return layer
+
+
+def llama_hidden(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    attention_fn: Optional[Any] = None,
+    remat: Any = "dots",
+) -> jax.Array:
+    """tokens: int32 [B, S] -> final-norm hidden states [B, S, dim]
+    (everything except the lm_head projection — see `llama_loss`'s chunked
+    path, which applies the head per sequence chunk)."""
+    h = params["embed"][tokens]  # [B,S,D]
     # scan over stacked layers: one compiled body, L iterations
-    body = remat_wrap(layer, remat)
+    body = remat_wrap(make_llama_layer_body(cfg, attention_fn), remat)
     h, _ = jax.lax.scan(body, h, params["layers"])
     return _rmsnorm(h, params["final_norm"], cfg.norm_eps)
 

@@ -85,7 +85,7 @@ def moe_init(key: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
             cfg.dtype
         )
 
-    ks = jax.random.split(k_layers, 9)
+    ks = jax.random.split(k_layers, 8)
     layers = {
         "attn_norm": jnp.ones((L, d), cfg.dtype),
         "wq": dense_init(ks[0], (L, d, cfg.n_heads * hd), d),

@@ -66,6 +66,10 @@ def dequantize_fp8_rowwise(
     """Inverse of quantize_fp8_rowwise; returns a flat array of length n."""
     assert _FP8 is not None
     q = payload.view(_FP8)
+    # accept both engines' scale shapes — (rows,) host vs (rows, 1) fused —
+    # a (rows, 1) input would otherwise broadcast to (rows, rows, row) and
+    # silently return truncated garbage
+    scales = np.asarray(scales).reshape(-1)
     mat = q.astype(np.float32) * scales[:, None].astype(np.float32)
     return mat.reshape(-1)[:n].astype(dtype)
 

@@ -108,35 +108,27 @@ def pp_param_specs(cfg: Any) -> Any:
     }
 
 
-def make_pp_llama_loss(cfg: Any, mesh: Mesh, num_microbatches: Optional[int] = None):
+def make_pp_llama_loss(cfg: Any, mesh: Mesh, num_microbatches: Optional[int] = None,
+                       remat: Any = "dots"):
     """Build a pipeline-parallel llama loss fn over mesh axis ``pp``.
 
     Embedding and the LM head run replicated on every stage (they are cheap
     relative to the layer stack at depth); only the last stage's logits are
     real, selected by a psum mask. Returns loss_fn(params, tokens, targets).
+
+    The layer body is the canonical one (models/llama.make_llama_layer_body)
+    wrapped in the shared remat policy — at the 8B/70B depths pipelining
+    targets, per-stage activation residency without remat would hit the HBM
+    ceiling.
     """
     from jax import shard_map
 
-    from torchft_tpu.models.llama import _attention, _rmsnorm, _rope
+    from torchft_tpu.models.llama import _rmsnorm, make_llama_layer_body
+    from torchft_tpu.models.remat import remat_wrap
+
+    layer = remat_wrap(make_llama_layer_body(cfg), remat)
 
     def loss_local(layers, embed, final_norm, lm_head, tokens, targets):
-        B, S = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(S), tokens.shape)
-
-        def layer(h, lp):
-            x = _rmsnorm(h, lp["attn_norm"], cfg.norm_eps)
-            Bm = x.shape[0]
-            q = (x @ lp["wq"]).reshape(Bm, S, cfg.n_heads, cfg.head_dim)
-            k = (x @ lp["wk"]).reshape(Bm, S, cfg.n_kv_heads, cfg.head_dim)
-            v = (x @ lp["wv"]).reshape(Bm, S, cfg.n_kv_heads, cfg.head_dim)
-            q = _rope(q, cfg.rope_theta, positions[:Bm])
-            k = _rope(k, cfg.rope_theta, positions[:Bm])
-            attn = _attention(q, k, v, cfg).reshape(Bm, S, cfg.n_heads * cfg.head_dim)
-            h = h + attn @ lp["wo"]
-            x = _rmsnorm(h, lp["ffn_norm"], cfg.norm_eps)
-            h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-            return h, None
-
         h = embed[tokens]
         h = pipeline_apply(
             layer, layers, h, axis_name="pp", num_microbatches=num_microbatches

@@ -103,11 +103,26 @@ class _Mailbox:
         self._payload: Optional[List[Any]] = None
         self._set = False
         self._error: Optional[Exception] = None
+        self._closed = False
 
-    def put(self, payload: List[Any]) -> None:
+    def put(self, payload: List[Any]) -> bool:
+        """Deposit; returns False when the receiver already gave up
+        (closed) — the payload is dropped instead of pinned forever."""
         with self._cond:
+            if self._closed:
+                return False
             self._payload = payload
             self._set = True
+            self._cond.notify_all()
+        return True
+
+    def close(self) -> None:
+        """Receiver gave up (timeout/abort): a late put must drop its
+        payload rather than park device arrays in an orphan mailbox that
+        no future recv (the seq counter advanced) will ever read."""
+        with self._cond:
+            self._closed = True
+            self._payload = None
             self._cond.notify_all()
 
     def fail(self, err: Exception) -> None:
@@ -815,8 +830,12 @@ class ProcessGroupXLA(ProcessGroup):
             )
         rank = self._rank
         kind = f"p2p_{rank}_{dst}_{tag}"
+        seq = self._bump_seq(kind)
         payload = [world.place(rank, a)[0] for a in arrays]
-        world.mailbox(kind, self._bump_seq(kind)).put(payload)
+        if not world.mailbox(kind, seq).put(payload):
+            # receiver already timed out / aborted this pairing: free the
+            # dict entry (payload was dropped by the closed mailbox)
+            world.gc_mailbox(kind, seq)
         return DummyWork(None)
 
     def recv(self, src: int, tag: int = 0) -> Work:
@@ -842,15 +861,19 @@ class ProcessGroupXLA(ProcessGroup):
                 fut.set_result(
                     [jax.device_put(a, world.leads[rank]) for a in payload]
                 )
+                # consume-once on success: drop the mailbox and its
+                # retained device arrays
+                world.gc_mailbox(kind, seq)
             except Exception as e:  # noqa: BLE001
                 try:
                     fut.set_exception(e)
                 except RuntimeError:
                     pass
-            finally:
-                # consume-once: drop the mailbox (and its retained device
-                # arrays) as soon as the transfer resolves either way
-                world.gc_mailbox(kind, seq)
+                # on timeout/abort, CLOSE but keep the dict entry: a late
+                # sender must find the closed mailbox and drop its payload
+                # (removing it here would let the sender re-create a fresh
+                # orphan that pins device arrays until reconfigure)
+                mb.close()
 
         threading.Thread(target=do_recv, daemon=True, name="pgxla_recv").start()
         return FutureWork(fut)

@@ -75,7 +75,13 @@ class ReplicaActor:
             while proc.poll() is None:
                 if self._stop.wait(0.5):
                     proc.terminate()
-                    proc.wait(timeout=30)
+                    try:
+                        proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        # SIGTERM-deaf worker: escalate so the actor thread
+                        # reaches a terminal status and no orphan survives
+                        proc.kill()
+                        proc.wait()
                     self.status = "stopped"
                     return
             if proc.returncode == 0:
@@ -112,18 +118,11 @@ class FailureController:
         self._thread.start()
 
     def _members(self) -> list:
-        import json
+        # one copy of the /status parsing: punisher.list_replicas handles
+        # both participant shapes (bare ids vs member objects)
+        from punisher import list_replicas
 
-        with urllib.request.urlopen(f"http://{self._addr}/status", timeout=10) as r:
-            status = json.loads(r.read().decode())
-        # steady-state members live in prev_quorum; `participants` only
-        # lists replicas currently blocked in a quorum call
-        members = [p["replica_id"] for p in status.get("participants", [])]
-        if status.get("prev_quorum"):
-            members += [
-                p["replica_id"]
-                for p in status["prev_quorum"].get("participants", [])
-            ]
+        members = list_replicas(self._addr)
         return sorted(set(members))
 
     def _run(self) -> None:

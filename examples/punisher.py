@@ -31,7 +31,13 @@ def _base(addr: str) -> str:
 def list_replicas(lighthouse: str) -> list:
     with urllib.request.urlopen(f"{_base(lighthouse)}/status", timeout=10) as r:
         status = json.loads(r.read().decode())
-    ids = {p["replica_id"] for p in status.get("participants", [])}
+    # top-level participants are BARE replica-id strings (replicas blocked
+    # in a quorum call right now); prev_quorum participants are member
+    # objects. Handle both shapes.
+    ids = {
+        p if isinstance(p, str) else p["replica_id"]
+        for p in status.get("participants", [])
+    }
     if status.get("prev_quorum"):
         ids |= {p["replica_id"] for p in status["prev_quorum"].get("participants", [])}
     return sorted(ids)

@@ -176,9 +176,22 @@ def demo(args) -> None:
     procs[victim] = spawn(victim)
 
     rc = 0
-    for rid, p in procs.items():
-        rc |= p.wait(timeout=300)
-    lh.shutdown()
+    try:
+        for rid, p in procs.items():
+            try:
+                rc |= p.wait(timeout=300)
+            except subprocess.TimeoutExpired:
+                # a wedged replica must not orphan its siblings or skip
+                # lighthouse shutdown
+                print(f"--- replica {rid} wedged; killing ---", flush=True)
+                p.kill()
+                p.wait()
+                rc |= 1
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        lh.shutdown()
     print("demo finished rc=", rc, flush=True)
     sys.exit(rc)
 

@@ -118,4 +118,7 @@ class ParameterServer(ABC):
 
     def shutdown(self) -> None:
         self._server.shutdown()
+        # release the listening socket (shutdown() only stops serve_forever);
+        # without this the port stays bound until process exit
+        self._server.server_close()
         self._store.shutdown()

@@ -56,9 +56,13 @@ class HTTPTransport(CheckpointTransport[Any]):
     ``num_chunks=0`` serves everything as one chunk.
     """
 
-    def __init__(self, timeout: "float | timedelta" = 60.0, num_chunks: int = 0) -> None:
+    def __init__(self, timeout: "float | timedelta" = 60.0, num_chunks: int = 0,
+                 hostname: str = "") -> None:
         self._timeout = _to_seconds(timeout)
         self._num_chunks = num_chunks
+        # advertised heal address: overridable for fleets where
+        # gethostname() is not peer-resolvable (e.g. k8s pods)
+        self._hostname = hostname
         # Write-locked whenever there is NO servable checkpoint; readers are
         # in-flight HTTP requests (reference: http_transport.py:181-202).
         self._state_lock = RWLock(timeout=self._timeout)
@@ -200,7 +204,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         return False
 
     def metadata(self) -> str:
-        host = socket.gethostname()
+        host = self._hostname or socket.gethostname()
         port = self._server.server_address[1]
         return f"http://{host}:{port}"
 
@@ -235,9 +239,18 @@ class HTTPTransport(CheckpointTransport[Any]):
                     timeout=grace,
                 )
             if not self._state_lock.w_acquire(timeout=self._timeout):
-                raise TimeoutError(
-                    "timed out waiting for in-flight checkpoint reads to finish"
+                # A straggling receiver still streaming must NOT kill the
+                # healthy donor (this raises out of should_commit). The
+                # staged snapshot owns independent copies, so the in-flight
+                # stream stays consistent even while training mutates live
+                # state; just close the window for new requests and let the
+                # next disallow re-attempt the lock.
+                logger.warning(
+                    "slow checkpoint receiver still streaming; closing the "
+                    "serving window without re-locking"
                 )
+                self._staged = None
+                return
             self._have_state = False
             self._staged = None
 

@@ -181,9 +181,7 @@ class Manager:
             else quorum_retries
         )
 
-        if checkpoint_transport is None:
-            checkpoint_transport = HTTPTransport(timeout=self._timeout)
-        self._checkpoint_transport: CheckpointTransport = checkpoint_transport
+        # (transport constructed after the hostname default below)
 
         # user state-dict functions, guarded against concurrent mutation
         # during checkpoint serving (reference: manager.py:243, 366-391)
@@ -196,6 +194,15 @@ class Manager:
         self._store: Optional[KvStoreServer] = None
         self._manager: Optional[ManagerServer] = None
         hostname = hostname or _socket.gethostname()
+
+        if checkpoint_transport is None:
+            # the heal URL must use the same peer-resolvable hostname the
+            # store/manager addresses use, or healing alone breaks on
+            # fleets where gethostname() doesn't resolve (k8s pods)
+            checkpoint_transport = HTTPTransport(
+                timeout=self._timeout, hostname=hostname
+            )
+        self._checkpoint_transport: CheckpointTransport = checkpoint_transport
 
         if group_rank == 0:
             # Group leader: owns the rendezvous store and the manager server.
@@ -539,15 +546,14 @@ class Manager:
         # the Pallas kernels quantize there and only the compressed payload
         # crosses to the host wire (collectives.py). Host-plane PGs with
         # plain numpy inputs get the numpy staging they require.
-        from torchft_tpu.collectives import is_device_tree
-
-        # quantized leaves only count as device-native when the Pallas
-        # kernels can actually run on them (single-device arrays; the same
-        # predicate collectives.py uses) — a mesh-sharded tree must take
-        # the staged host path, not a caller-thread cross-device gather
-        device_native = getattr(self._pg, "device_native", False) or (
-            should_quantize and is_device_tree(leaves)
-        )
+        # Only a device-native PG (ProcessGroupXLA) bypasses the staging
+        # worker: its ops rendezvous by (kind, seq) so issue order across
+        # threads cannot mismatch. On a host PG EVERYTHING — including the
+        # quantized pipeline, whose alltoall/allgather would otherwise be
+        # issued from an unordered helper thread — goes through the one
+        # ordered staging worker (host exchange matches messages purely by
+        # arrival order; cross-replica issue order is the contract).
+        device_native = getattr(self._pg, "device_native", False)
 
         pg_reduce_op = reduce_op
         if reduce_op == ReduceOp.AVG:
@@ -612,28 +618,45 @@ class Manager:
                 else:
                     capture = None
                 zero_specs = [(np.shape(l), _np_dtype(l)) for l in leaves]
+                stage_timeout = self._timeout
 
                 def stage() -> None:
                     """D2H + dispatch only — the PG's own ordered worker
                     runs the wire, and the result chains in via callback.
                     Blocking here would serialize overlapped allreduces on
                     this one thread and charge queue time against later
-                    calls' wrap_future timeouts."""
+                    calls' wrap_future timeouts. EXCEPTION: the quantized
+                    pipeline runs to completion here — its alltoall and
+                    allgather must be issued in staged order (they would
+                    otherwise race other staged ops from its helper
+                    thread), and quantized syncs are rare boundary events
+                    (DiLoCo) where the serialization is acceptable."""
                     try:
+                        if should_quantize:
+                            from torchft_tpu.collectives import allreduce_quantized
+
+                            if capture is None:
+                                wire_leaves = [
+                                    np.zeros(s, d) for s, d in zero_specs
+                                ]
+                            else:
+                                # keep jax copies as-is: single-device
+                                # trees take the Pallas engine
+                                wire_leaves = capture
+                            w = allreduce_quantized(
+                                wire_leaves, pg_reduce_op, self._pg
+                            )
+                            staged_fut.set_result(
+                                w.get_future().wait(stage_timeout)
+                            )
+                            return
                         if capture is None:
                             host_leaves = [
                                 np.zeros(s, d) for s, d in zero_specs
                             ]
                         else:
                             host_leaves = [np.asarray(l) for l in capture]
-                        if should_quantize:
-                            from torchft_tpu.collectives import allreduce_quantized
-
-                            w = allreduce_quantized(
-                                host_leaves, pg_reduce_op, self._pg
-                            )
-                        else:
-                            w = self._pg.allreduce(host_leaves, pg_reduce_op)
+                        w = self._pg.allreduce(host_leaves, pg_reduce_op)
 
                         def _xfer(f: Future) -> None:
                             try:
@@ -675,7 +698,7 @@ class Manager:
                 staged_fut.add_done_callback(_unpin)
 
             fut = fut.then(normalize)
-            fut = self.wrap_future(fut, zeros())
+            fut = self.wrap_future(fut, zeros)  # factory: built only on error
             return FutureWork(fut)
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"got exception in allreduce -- skipping remaining: {e}")
@@ -715,12 +738,17 @@ class Manager:
     def wrap_future(
         self,
         fut: Future[T],
-        default: T,
+        default: Any,
         timeout: "float | timedelta | None" = None,
     ) -> Future[T]:
         """Timeout + swallow errors into ``default``, reporting them
-        (reference: manager.py:516-558)."""
-        timed = future_timeout(fut, _to_seconds(timeout) if timeout else self._timeout)
+        (reference: manager.py:516-558). ``default`` may be a zero-arg
+        factory — then the fallback value is only built on the error path,
+        not eagerly per call (a zeros pytree of a multi-GB gradient tree
+        would otherwise cost host alloc + H2D on every healthy step)."""
+        timed = future_timeout(
+            fut, _to_seconds(timeout) if timeout is not None else self._timeout
+        )
 
         def callback(f: Future[T]) -> T:
             try:
@@ -728,7 +756,7 @@ class Manager:
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in future -- skipping remaining: {e}")
                 self.report_error(e)
-                return default
+                return default() if callable(default) else default
 
         return timed.then(callback)
 
@@ -761,7 +789,7 @@ class Manager:
             self._group_rank,
             self._step,
             local_should_commit,
-            timeout=_to_seconds(timeout) if timeout else self._timeout,
+            timeout=_to_seconds(timeout) if timeout is not None else self._timeout,
         )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas} errored={self._errored is not None}"

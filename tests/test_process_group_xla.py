@@ -231,7 +231,7 @@ class TestLocalMode:
             is_participating = lambda self: True
             report_error = lambda self, e: None
 
-            def wrap_future(self, fut, default):
+            def wrap_future(self, fut, default, **kwargs):
                 return fut
 
             allreduce = Manager.allreduce

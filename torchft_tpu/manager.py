@@ -620,6 +620,17 @@ class Manager:
                 zero_specs = [(np.shape(l), _np_dtype(l)) for l in leaves]
                 stage_timeout = self._timeout
 
+                def _stage_deadline() -> None:
+                    # fail-the-future watchdog armed when staging BEGINS
+                    # (not at submission: queue time behind an in-flight
+                    # quantized sync must not count against this op)
+                    try:
+                        staged_fut.set_exception(
+                            TimeoutError("allreduce staging timed out")
+                        )
+                    except RuntimeError:
+                        pass
+
                 def stage() -> None:
                     """D2H + dispatch only — the PG's own ordered worker
                     runs the wire, and the result chains in via callback.
@@ -632,6 +643,9 @@ class Manager:
                     thread), and quantized syncs are rare boundary events
                     (DiLoCo) where the serialization is acceptable."""
                     try:
+                      from torchft_tpu.futures import context_timeout as _ctx
+
+                      with _ctx(_stage_deadline, stage_timeout):
                         if should_quantize:
                             from torchft_tpu.collectives import allreduce_quantized
 
@@ -698,7 +712,11 @@ class Manager:
                 staged_fut.add_done_callback(_unpin)
 
             fut = fut.then(normalize)
-            fut = self.wrap_future(fut, zeros)  # factory: built only on error
+            # device path: submission-time timer (op starts immediately).
+            # host path: the stage-start watchdog above owns the deadline —
+            # a submission timer would charge queue time behind an
+            # in-flight quantized sync against this op.
+            fut = self.wrap_future(fut, zeros, arm_timeout=device_native)
             return FutureWork(fut)
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"got exception in allreduce -- skipping remaining: {e}")
@@ -740,15 +758,25 @@ class Manager:
         fut: Future[T],
         default: Any,
         timeout: "float | timedelta | None" = None,
+        arm_timeout: bool = True,
     ) -> Future[T]:
         """Timeout + swallow errors into ``default``, reporting them
         (reference: manager.py:516-558). ``default`` may be a zero-arg
         factory — then the fallback value is only built on the error path,
         not eagerly per call (a zeros pytree of a multi-GB gradient tree
-        would otherwise cost host alloc + H2D on every healthy step)."""
-        timed = future_timeout(
-            fut, _to_seconds(timeout) if timeout is not None else self._timeout
-        )
+        would otherwise cost host alloc + H2D on every healthy step).
+
+        ``arm_timeout=False`` skips the submission-time timer for callers
+        that arm their own deadline when work actually STARTS (the staged
+        host path: a timer started at submission would charge queue time
+        behind an in-flight quantized sync against this op)."""
+        if arm_timeout:
+            timed = future_timeout(
+                fut,
+                _to_seconds(timeout) if timeout is not None else self._timeout,
+            )
+        else:
+            timed = fut
 
         def callback(f: Future[T]) -> T:
             try:

@@ -27,7 +27,7 @@ def main():
     seq = 2048
     attn = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
     for remat_mode, batch, chunk in itertools.product(
-        ["dots", "none", "full"], [8, 16, 32], [0, 512]
+        ["dots", "none", "full", "attn"], [8, 16, 32], [0, 512]
     ):
         try:
             tps, mfu = timed_train_step(cfg, batch, seq, steps=10,

@@ -165,6 +165,112 @@ class TestQuorumHappyPath:
         np.testing.assert_allclose(out["w"], 0.0)
         first.get_future().wait(timeout=10)
 
+    def test_wire_phase_bounded_when_pg_never_resolves(self):
+        """The stage deadline must cover the WIRE phase, not just dispatch:
+        a PG whose allreduce dispatches fine but whose future never resolves
+        (hung peer whose abort path also failed) must fail the staged op at
+        ~manager timeout and swallow to zeros — not block the train loop
+        until the caller's wait() expires (regression: the old watchdog was
+        a `with` around the dispatching frame, disarmed the moment the op
+        was queued on the PG worker)."""
+        import time as _time
+
+        from torchft_tpu.process_group import ProcessGroup
+        from torchft_tpu.work import Future, FutureWork
+
+        class HungWirePG(ProcessGroup):
+            def configure(self, *a, **k):
+                pass
+
+            def allreduce(self, arrays, op=ReduceOp.SUM):
+                return FutureWork(Future())  # dispatches, never resolves
+
+            def errored(self):
+                return None
+
+            def abort(self):
+                pass
+
+            def shutdown(self):
+                pass
+
+            def size(self):
+                return 1
+
+            def rank(self):
+                return 0
+
+            def allgather(self, arrays):  # pragma: no cover - unused
+                raise NotImplementedError
+
+            broadcast = reduce_scatter = alltoall = send = recv = allgather
+
+        m = make_manager(pg=HungWirePG(), quorum=make_quorum(), timeout=2.0)
+        m.start_quorum()
+        t0 = _time.monotonic()
+        out = m.allreduce({"w": np.ones(2, np.float32)}).get_future().wait(
+            timeout=30
+        )
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 10.0, f"wire phase unbounded: took {elapsed:.1f}s"
+        np.testing.assert_allclose(out["w"], 0.0)  # swallowed to zeros
+        assert m.errored() is not None
+        m.shutdown(wait=False)
+
+    def test_backstop_bounds_op_queued_behind_wedged_stage(self):
+        """An op queued behind a stage() that wedges FOREVER (D2H against a
+        hung device) never gets its stage-start deadline armed — the
+        submission-time 2x backstop must bound it anyway (regression: with
+        only the stage-start watchdog, op N+1's future never resolved)."""
+        import threading
+        import time as _time
+
+        from torchft_tpu.process_group import ProcessGroup
+        from torchft_tpu.work import DummyWork
+
+        unstick = threading.Event()
+
+        class WedgedPG(ProcessGroup):
+            def configure(self, *a, **k):
+                pass
+
+            def allreduce(self, arrays, op=ReduceOp.SUM):
+                unstick.wait(60)  # wedge the single staging worker
+                return DummyWork(list(arrays))
+
+            def errored(self):
+                return None
+
+            def abort(self):
+                pass
+
+            def shutdown(self):
+                unstick.set()
+
+            def size(self):
+                return 1
+
+            def rank(self):
+                return 0
+
+            def allgather(self, arrays):  # pragma: no cover - unused
+                raise NotImplementedError
+
+            broadcast = reduce_scatter = alltoall = send = recv = allgather
+
+        m = make_manager(pg=WedgedPG(), quorum=make_quorum(), timeout=1.0)
+        m.start_quorum()
+        first = m.allreduce({"w": np.ones(2, np.float32)})  # wedges stage()
+        second = m.allreduce({"w": np.ones(2, np.float32)})  # queued forever
+        t0 = _time.monotonic()
+        out = second.get_future().wait(timeout=30)
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 8.0, f"queued op unbounded: took {elapsed:.1f}s"
+        np.testing.assert_allclose(out["w"], 0.0)
+        first.get_future().wait(timeout=30)
+        unstick.set()
+        m.shutdown(wait=False)
+
     def test_host_staging_survives_buffer_donation(self):
         """The staging thread reads the gradients after allreduce() returns;
         a caller donating its buffers in the next jitted step must not turn

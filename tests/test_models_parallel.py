@@ -77,6 +77,27 @@ class TestLlama:
                 rtol=2e-3, atol=1e-5,
             )
 
+    @pytest.mark.parametrize("mode", ["dots", "attn", "full"])
+    def test_remat_modes_change_nothing_but_memory(self, params, mode):
+        """Every remat mode is a pure recompute schedule: loss and gradients
+        must match the no-remat path bit-for-near-bit."""
+        key = jax.random.PRNGKey(3)
+        tokens = jax.random.randint(key, (2, 16), 0, CFG.vocab_size)
+        base, g_base = jax.value_and_grad(llama_loss)(
+            params, tokens, tokens, CFG, remat="none"
+        )
+        got, g_got = jax.value_and_grad(llama_loss)(
+            params, tokens, tokens, CFG, remat=mode
+        )
+        np.testing.assert_allclose(float(base), float(got), rtol=1e-6)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_base), jax.tree_util.tree_leaves(g_got)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-3, atol=1e-5,
+            )
+
     def test_chunk_must_divide_seq(self, params):
         tokens = jnp.zeros((1, 16), jnp.int32)
         with pytest.raises(ValueError, match="divide"):

@@ -32,7 +32,13 @@ T = TypeVar("T")
 
 WATCHDOG_TIMEOUT_SEC = float(os.environ.get("TORCHFT_WATCHDOG_TIMEOUT_SEC", 30.0))
 
-__all__ = ["future_timeout", "future_wait", "context_timeout", "stop_timeout_manager"]
+__all__ = [
+    "future_timeout",
+    "future_wait",
+    "context_timeout",
+    "arm_deadline",
+    "stop_timeout_manager",
+]
 
 
 def _to_seconds(timeout: "float | timedelta") -> float:
@@ -165,21 +171,24 @@ class _TimeoutManager:
         fut.add_done_callback(_transfer)
         return out
 
+    def arm(self, callback: Callable[[], None], timeout: float) -> Callable[[], None]:
+        loop = self._maybe_start()
+        handle = _TimerHandle()
+        loop.call_soon_threadsafe(
+            lambda: handle.set_timer_handle(loop.call_later(timeout, callback))
+        )
+        return handle.cancel
+
     def context_timeout(
         self, callback: Callable[[], None], timeout: float
     ) -> "Generator[None, None, None]":
-        loop = self._maybe_start()
-        handle = _TimerHandle()
-
         @contextmanager
         def _ctx() -> Generator[None, None, None]:
-            loop.call_soon_threadsafe(
-                lambda: handle.set_timer_handle(loop.call_later(timeout, callback))
-            )
+            cancel = self.arm(callback, timeout)
             try:
                 yield
             finally:
-                handle.cancel()
+                cancel()
 
         return _ctx()
 
@@ -206,6 +215,19 @@ def context_timeout(
     reference's abort-based timeout recovery (torchft/process_group.py:739-763).
     """
     return _TIMEOUT_MANAGER.context_timeout(callback, _to_seconds(timeout))
+
+
+def arm_deadline(
+    callback: Callable[[], None], timeout: "float | timedelta"
+) -> Callable[[], None]:
+    """Arm ``callback`` to fire after ``timeout``; returns a cancel function.
+
+    The bare-timer primitive behind ``context_timeout``, for ops whose
+    completion signal is a future resolving rather than a ``with`` block
+    exiting — cancel from the future's done-callback so the deadline covers
+    the full async span, not just the dispatching frame.
+    """
+    return _TIMEOUT_MANAGER.arm(callback, _to_seconds(timeout))
 
 
 def stop_timeout_manager() -> None:

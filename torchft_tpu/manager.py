@@ -643,9 +643,19 @@ class Manager:
                     thread), and quantized syncs are rare boundary events
                     (DiLoCo) where the serialization is acceptable."""
                     try:
-                      from torchft_tpu.futures import context_timeout as _ctx
+                        from torchft_tpu.futures import arm_deadline
 
-                      with _ctx(_stage_deadline, stage_timeout):
+                        # The tight deadline spans the WHOLE staged op —
+                        # D2H, dispatch, AND the wire phase the PG worker
+                        # resolves via callback after this function
+                        # returns. A `with` around just this frame would
+                        # disarm at dispatch, leaving a never-resolving
+                        # wire (hung peer whose abort path also fails)
+                        # unbounded. Cancelled the moment staged_fut
+                        # settles, so queue time behind an in-flight
+                        # quantized sync still never counts against it.
+                        cancel = arm_deadline(_stage_deadline, stage_timeout)
+                        staged_fut.add_done_callback(lambda _f: cancel())
                         if should_quantize:
                             from torchft_tpu.collectives import allreduce_quantized
 
@@ -693,9 +703,25 @@ class Manager:
                 # appended after the sweep would never have its staged
                 # future failed (full-timeout stall), and a submit after
                 # executor shutdown raises anyway
+                from torchft_tpu.futures import arm_deadline as _arm
+
                 with self._staged_lock:
                     if self._staging_down:
                         raise RuntimeError("manager is shut down")
+                    # Submission-time depth-aware BACKSTOP: if an op ahead
+                    # of us wedges its stage() forever (D2H against a hung
+                    # device, a dispatch that never returns), our stage()
+                    # never runs and the tight stage-start deadline is
+                    # never armed. Healthy queue time is bounded by one
+                    # deadline per op ahead (each stage() blocks at most
+                    # stage_timeout), so depth+2 slots never fire on a
+                    # healthy queue; both timers race to the same
+                    # set_exception and the loser is a no-op.
+                    depth = len(self._staged_pending)
+                    backstop_cancel = _arm(
+                        _stage_deadline, (depth + 2) * stage_timeout
+                    )
+                    staged_fut.add_done_callback(lambda _f: backstop_cancel())
                     exec_fut = self._staging_executor.submit(stage)
                     pair = (exec_fut, staged_fut)
                     self._staged_pending.append(pair)

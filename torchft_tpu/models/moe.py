@@ -221,7 +221,7 @@ def moe_forward(
 ) -> Tuple[jax.Array, jax.Array]:
     """tokens int32 [B, S] -> (logits f32 [B, S, V], total aux loss).
 
-    ``remat`` takes the shared modes ("none"/"dots"/"full" or bool aliases;
+    ``remat`` takes the shared modes ("none"/"dots"/"attn"/"full" or bool aliases;
     torchft_tpu.models.remat). Default full remat: MoE layers hold per-expert
     activations, so the conservative mode is the safe default."""
     attention = attention_fn or _attention

@@ -1,8 +1,8 @@
 """Shared rematerialization policy for scanned transformer layer bodies.
 
 One policy module for every model family (llama, moe) so the remat semantics
-can't diverge: modes are "none" / "dots" / "full" (bools accepted as aliases
-for none/full for backward compatibility).
+can't diverge: modes are "none" / "dots" / "attn" / "full" (bools accepted
+as aliases for none/full for backward compatibility).
 
 On TPU the interesting trade is HBM capacity vs backward-pass FLOPs:
 
@@ -14,8 +14,13 @@ On TPU the interesting trade is HBM capacity vs backward-pass FLOPs:
   value tagged `checkpoint_name(..., "attn_out")` — the attention kernel is
   a custom_vjp whose output is not a dot in the jaxpr, so without the tag
   the whole flash forward would be recomputed in backward. Near-no-remat
-  step time at a fraction of its activation memory; the right default for
-  configs that fit (the single-chip bench).
+  backward FLOPs at a fraction of no-remat activation memory.
+- "attn": saves ONLY the tagged attention outputs; every plain matmul is
+  recomputed in backward. The attention kernel is the one block whose
+  recompute is disproportionately expensive (a full Pallas flash forward),
+  while the dense matmuls recompute at MXU speed from residuals already in
+  HBM — so this keeps nearly full-remat's memory footprint but removes the
+  most expensive third of the recompute.
 - "none": XLA saves all residuals.
 """
 
@@ -41,5 +46,8 @@ def remat_wrap(layer: Callable, remat: Any) -> Callable:
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names(ATTN_OUT_NAME),
         )
+        return jax.checkpoint(layer, policy=policy)
+    if remat == "attn":
+        policy = jax.checkpoint_policies.save_only_these_names(ATTN_OUT_NAME)
         return jax.checkpoint(layer, policy=policy)
     raise ValueError(f"unknown remat mode: {remat!r}")

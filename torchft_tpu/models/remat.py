@@ -20,7 +20,11 @@ On TPU the interesting trade is HBM capacity vs backward-pass FLOPs:
   recompute is disproportionately expensive (a full Pallas flash forward),
   while the dense matmuls recompute at MXU speed from residuals already in
   HBM — so this keeps nearly full-remat's memory footprint but removes the
-  most expensive third of the recompute.
+  most expensive third of the recompute. CAVEAT: as of July 2026 the
+  save-only-named-residuals policy wedges the TPU compiler (>25 min, never
+  returns) on the bench config with the splash kernel; it compiles and
+  runs fine on CPU and is numerically pinned by the grad-equivalence test.
+  Prefer "full" on TPU until a toolchain update clears it.
 - "none": XLA saves all residuals.
 """
 

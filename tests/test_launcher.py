@@ -85,9 +85,15 @@ def test_launch_out_of_restarts_fails(tmp_path):
 
 
 def test_lighthouse_cli_and_dashboard():
-    """Boot the CLI in a subprocess, hit /status, then terminate."""
+    """Boot the CLI in a subprocess, hit /status, then terminate. Flags use
+    the reference CLI's underscore spellings (src/lighthouse.rs structopt
+    longs) — both spellings must launch, so a torchft script ports as-is."""
     proc = subprocess.Popen(
-        [sys.executable, "-m", "torchft_tpu.lighthouse", "--bind", "127.0.0.1:0"],
+        [
+            sys.executable, "-m", "torchft_tpu.lighthouse",
+            "--bind", "127.0.0.1:0",
+            "--min_replicas", "1", "--quorum_tick_ms", "50",
+        ],
         stderr=subprocess.PIPE,
         text=True,
     )

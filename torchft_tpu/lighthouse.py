@@ -25,10 +25,19 @@ def main(argv: "list[str] | None" = None) -> None:
         prog="torchft_tpu_lighthouse", description=__doc__
     )
     parser.add_argument("--bind", default="0.0.0.0:29510")
-    parser.add_argument("--min-replicas", type=int, default=1)
-    parser.add_argument("--join-timeout-ms", type=int, default=60000)
-    parser.add_argument("--quorum-tick-ms", type=int, default=100)
-    parser.add_argument("--heartbeat-timeout-ms", type=int, default=5000)
+    # each flag also accepts the reference CLI's underscore spelling
+    # (src/lighthouse.rs structopt longs are --min_replicas etc.), so a
+    # torchft launch script ports without edits
+    parser.add_argument("--min-replicas", "--min_replicas", type=int, default=1)
+    parser.add_argument(
+        "--join-timeout-ms", "--join_timeout_ms", type=int, default=60000
+    )
+    parser.add_argument(
+        "--quorum-tick-ms", "--quorum_tick_ms", type=int, default=100
+    )
+    parser.add_argument(
+        "--heartbeat-timeout-ms", "--heartbeat_timeout_ms", type=int, default=5000
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)

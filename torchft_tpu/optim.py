@@ -19,15 +19,19 @@ __all__ = ["OptimizerWrapper"]
 
 
 class OptimizerWrapper:
-    """Usage::
+    """Usage (the heal-safe idiom — vote, then read state, then update)::
 
         optimizer = OptimizerWrapper(manager, optax.adamw(3e-4))
-        opt_state = optimizer.init(params)
+        state = {"params": params, "opt_state": optimizer.init(params)}
+        # register state-dict fns that read/write `state` with the manager
         for batch in data:
             optimizer.start_step()            # zero_grad(): starts quorum
-            grads = grad_fn(params, batch)
+            grads = grad_fn(state["params"], batch)
             avg = manager.allreduce(grads).get_future().wait()
-            params, opt_state, committed = optimizer.step(params, opt_state, avg)
+            if optimizer.commit():            # a live heal lands HERE
+                state["params"], state["opt_state"] = optimizer.apply(
+                    state["params"], state["opt_state"], avg
+                )
     """
 
     def __init__(self, manager: Manager, tx: optax.GradientTransformation) -> None:
@@ -44,19 +48,61 @@ class OptimizerWrapper:
     # alias for API parity with the reference
     zero_grad = start_step
 
-    def step(
-        self, params: Any, opt_state: optax.OptState, grads: Any
-    ) -> Tuple[Any, optax.OptState, bool]:
-        """Apply the update iff the replica group's commit vote succeeds.
+    def commit(self) -> bool:
+        """The commit vote alone (``manager.should_commit()``).
 
-        Returns (params, opt_state, committed); on a failed vote both params
-        and opt_state are returned unchanged and the step is discarded.
+        Splitting the vote from the arithmetic matters in functional code:
+        a live heal lands DURING the vote (the pending recovered state is
+        written through the registered load_state_dict fn inside
+        ``should_commit``), so params captured before the vote are stale on
+        exactly the step that healed. Vote first, then read state and call
+        :meth:`update` — the mutable-dict idiom (docs/migration.md).
         """
-        if not self.manager.should_commit():
-            return params, opt_state, False
+        return self.manager.should_commit()
+
+    def apply(
+        self, params: Any, opt_state: optax.OptState, grads: Any
+    ) -> Tuple[Any, optax.OptState]:
+        """The optimizer arithmetic alone — call after :meth:`commit`
+        returned True, with params/opt_state read AFTER the vote.
+
+        (Named ``apply``, not ``update``: optax's ``tx.update`` takes
+        ``(grads, opt_state, params)`` — a same-arity all-pytree signature
+        with the outer arguments swapped relative to this params-first
+        method. A name collision would let a misordered call run silently
+        and train on garbage.)
+
+        Non-participants (a replica that just healed under async quorum, a
+        FIXED_WITH_SPARES spare) apply this too: their own contribution was
+        zeroed but they RECEIVE the cohort's average (reference
+        manager.py:441-451 — zero the input, join the collective, divide by
+        num_participants), and applying the same update to the same healed
+        entry-of-step params is precisely what keeps them in bitwise
+        lockstep with the cohort (tests/test_flax_interop.py pins this).
+        """
         import jax
         import jax.numpy as jnp
 
         grads = jax.tree_util.tree_map(jnp.asarray, grads)
         updates, new_state = self.tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), new_state, True
+        return optax.apply_updates(params, updates), new_state
+
+    def step(
+        self, params: Any, opt_state: optax.OptState, grads: Any
+    ) -> Tuple[Any, optax.OptState, bool]:
+        """Vote + update in one call (reference torchft/optim.py:52-55).
+
+        Returns (params, opt_state, committed); on a failed vote both are
+        returned unchanged and the step is discarded.
+
+        CAVEAT: ``params``/``opt_state`` were necessarily read before the
+        vote, so on a step that live-healed this replica the update is
+        applied to stale inputs. Loops that can heal (any loop under a
+        Manager with peers) should use ``commit()`` + ``apply()`` with
+        post-vote reads instead; ``step()`` is fine for spare-less,
+        heal-free settings and mirrors the reference API.
+        """
+        if not self.manager.should_commit():
+            return params, opt_state, False
+        new_params, new_state = self.apply(params, opt_state, grads)
+        return new_params, new_state, True

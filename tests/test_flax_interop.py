@@ -46,8 +46,17 @@ class _Die(Exception):
 def test_flax_model_trains_and_heals():
     model = MLP()
     tx = optax.adamw(1e-2)
-    xs = jax.random.normal(jax.random.PRNGKey(42), (8, 8))
-    ys = jnp.zeros((8,), jnp.int32)
+    # per-replica data (DistributedSampler-style shards): the replicas'
+    # gradients DIFFER, so bitwise equality below can only come from a
+    # working allreduce + a working heal — with shared data, a broken heal
+    # that silently retrained from init would still end equal
+    data = {
+        r: (
+            jax.random.normal(jax.random.PRNGKey(42 + r), (8, 8)),
+            jnp.full((8,), r, jnp.int32),
+        )
+        for r in range(2)
+    }
 
     def loss_fn(params, x, y):
         logits = model.apply(params, x)
@@ -64,6 +73,7 @@ def test_flax_model_trains_and_heals():
         quorum_tick_ms=20, heartbeat_timeout_ms=1000,
     )
     finals: dict = {}
+    healed_seen = threading.Event()
 
     def replica(rid: int, barrier: threading.Barrier) -> None:
         attempts = 0
@@ -71,6 +81,7 @@ def test_flax_model_trains_and_heals():
             attempts += 1
             # flax init gives the params pytree; every replica starts from
             # the same seed, as DDP requires
+            xs, ys = data[rid]
             init_params = model.init(jax.random.PRNGKey(0), xs)
             state = {
                 "params": init_params,
@@ -118,6 +129,8 @@ def test_flax_model_trains_and_heals():
                         state["params"], state["opt_state"] = optimizer.apply(
                             state["params"], state["opt_state"], avg
                         )
+                    if manager.last_quorum_healed():
+                        healed_seen.set()
                     if attempts == 1 and rid == 1 and manager.current_step() >= kill_at:
                         raise _Die()
                 finals[rid] = jax.tree_util.tree_map(
@@ -135,13 +148,19 @@ def test_flax_model_trains_and_heals():
                 raise
 
     barrier = threading.Barrier(2)
-    with ThreadPoolExecutor(max_workers=2) as ex:
+    ex = ThreadPoolExecutor(max_workers=2)
+    try:
         futs = [ex.submit(replica, r, barrier) for r in range(2)]
         for f in futs:
             f.result(timeout=180)
-    lh.shutdown()
+    finally:
+        # never join hung replica threads on the failure path — that would
+        # turn an assertion into a pytest hang
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
 
     assert set(finals) == {0, 1}
+    assert healed_seen.is_set(), "no live heal ever happened"
     # the healed replica must land bitwise-equal with the survivor
     for a, b in zip(
         jax.tree_util.tree_leaves(finals[0]),
@@ -150,7 +169,9 @@ def test_flax_model_trains_and_heals():
         np.testing.assert_array_equal(a, b)
     # and training actually moved the params
     init = jax.tree_util.tree_leaves(
-        jax.tree_util.tree_map(np.asarray, MLP().init(jax.random.PRNGKey(0), xs))
+        jax.tree_util.tree_map(
+            np.asarray, MLP().init(jax.random.PRNGKey(0), data[0][0])
+        )
     )
     moved = any(
         not np.array_equal(a, b)

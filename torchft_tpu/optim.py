@@ -56,7 +56,7 @@ class OptimizerWrapper:
         written through the registered load_state_dict fn inside
         ``should_commit``), so params captured before the vote are stale on
         exactly the step that healed. Vote first, then read state and call
-        :meth:`update` — the mutable-dict idiom (docs/migration.md).
+        :meth:`apply` — the mutable-dict idiom (docs/migration.md).
         """
         return self.manager.should_commit()
 

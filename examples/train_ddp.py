@@ -137,6 +137,7 @@ def demo(args) -> None:
         env = dict(os.environ, TORCHFT_LIGHTHOUSE=addr, REPLICA_GROUP_ID=str(rid))
         return subprocess.Popen(
             [sys.executable, __file__, "--steps", str(args.steps),
+             "--batch-size", str(args.batch_size),
              "--virtual-chips", "1"],
             env=env,
         )

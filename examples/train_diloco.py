@@ -159,6 +159,7 @@ def demo(args) -> None:
         env = dict(os.environ, TORCHFT_LIGHTHOUSE=addr, REPLICA_GROUP_ID=str(rid))
         return subprocess.Popen(
             [sys.executable, __file__, "--steps", str(args.steps),
+             "--batch-size", str(args.batch_size),
              "--sync-every", str(args.sync_every),
              "--num-fragments", str(args.num_fragments),
              "--virtual-chips", "1"],

@@ -1,0 +1,151 @@
+"""Randomized chaos soak: replicas die at arbitrary protocol points.
+
+The deterministic integration suite (tests/test_manager_integ.py) kills
+replicas at chosen (replica, step) events; protocol races live in the
+points those scenarios never hit — mid-quorum, mid-allreduce, mid-heal,
+during another replica's recovery send. This soak kills a random replica
+at a random time every few hundred milliseconds for a bounded wall-clock
+window, then stops the chaos and requires the system to (a) finish — no
+deadlock survives the generous timeout — and (b) converge: every replica
+reaches the target step and all final params are bitwise-equal (SGD
+updates, so lockstep is exact, and per-replica data shards mean equality
+can only come from real averaging + real healing; the kill flag is
+checked mid-step so death lands at commit boundaries, between steps, and
+immediately after heals alike).
+
+Chaos tooling parity: the reference drives this style of testing
+externally via its slurm punisher (examples/slurm/punisher.py kill_loop);
+here it is in-suite and seeded for reproducibility.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.manager import Manager
+from torchft_tpu.process_group import ProcessGroupHost
+
+N_REPLICAS = 3
+TARGET_STEPS = 30
+LR = 0.05
+CHAOS_SECONDS = 12.0
+KILL_PERIOD = (0.3, 1.2)  # uniform seconds between kills
+
+
+class _Killed(Exception):
+    pass
+
+
+@pytest.mark.slow
+def test_random_kills_converge_bitwise():
+    rng = random.Random(0xC0FFEE)
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800,
+    )
+    kill_flags = [threading.Event() for _ in range(N_REPLICAS)]
+    alive = [threading.Event() for _ in range(N_REPLICAS)]
+    stop_chaos = threading.Event()
+    finals: dict = {}
+    heal_count = [0]
+    heal_lock = threading.Lock()
+
+    def replica(rid: int) -> None:
+        data_rng = np.random.RandomState(100 + rid)
+        grad_base = data_rng.randn(8).astype(np.float32)  # replica's shard
+        while True:
+            params = {"w": np.zeros(8, np.float32)}
+
+            def load(sd, params=params):
+                params["w"] = np.array(sd["w"], dtype=np.float32)
+
+            manager = Manager(
+                pg=ProcessGroupHost(timeout=8.0),
+                load_state_dict=load,
+                state_dict=lambda params=params: {"w": params["w"].copy()},
+                min_replica_size=1,
+                use_async_quorum=True,
+                replica_id=f"chaos_{rid}",
+                lighthouse_addr=f"127.0.0.1:{lh.port}",
+                timeout=8.0,
+                quorum_timeout=8.0,
+            )
+            alive[rid].set()
+            try:
+                while manager.current_step() < TARGET_STEPS:
+                    if kill_flags[rid].is_set():
+                        kill_flags[rid].clear()
+                        raise _Killed()
+                    manager.start_quorum()
+                    # deterministic per-(replica, step) gradient: lockstep
+                    # across restarts requires the same contribution at the
+                    # same protocol step regardless of when kills landed
+                    step = manager.current_step()
+                    grads = {
+                        "w": (grad_base * (1.0 + 0.01 * step)).astype(
+                            np.float32
+                        )
+                    }
+                    avg = manager.allreduce(grads).get_future().wait(30)
+                    if kill_flags[rid].is_set():
+                        kill_flags[rid].clear()
+                        raise _Killed()
+                    if manager.should_commit():
+                        # post-vote read: heals land during the vote
+                        params["w"] = (
+                            params["w"] - LR * np.asarray(avg["w"])
+                        ).astype(np.float32)
+                    if manager.last_quorum_healed():
+                        with heal_lock:
+                            heal_count[0] += 1
+                finals[rid] = params["w"].copy()
+                manager.shutdown(wait=False)
+                return
+            except _Killed:
+                alive[rid].clear()
+                manager.shutdown(wait=False)
+                # restart delay: let the surviving quorum notice the death
+                time.sleep(rng.uniform(0.1, 0.5))
+                continue
+            except BaseException:
+                alive[rid].clear()
+                manager.shutdown(wait=False)
+                raise
+
+    def chaos() -> None:
+        deadline = time.monotonic() + CHAOS_SECONDS
+        while time.monotonic() < deadline and not stop_chaos.is_set():
+            time.sleep(rng.uniform(*KILL_PERIOD))
+            live = [r for r in range(N_REPLICAS) if alive[r].is_set()]
+            if len(live) <= 1:
+                continue  # always leave at least one survivor
+            kill_flags[rng.choice(live)].set()
+
+    ex = ThreadPoolExecutor(max_workers=N_REPLICAS + 1)
+    try:
+        futs = [ex.submit(replica, r) for r in range(N_REPLICAS)]
+        chaos_fut = ex.submit(chaos)
+        chaos_fut.result(timeout=CHAOS_SECONDS + 10)
+        for f in futs:
+            f.result(timeout=240)
+    finally:
+        stop_chaos.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+
+    assert set(finals) == set(range(N_REPLICAS)), finals.keys()
+    for rid in range(1, N_REPLICAS):
+        np.testing.assert_array_equal(
+            finals[0], finals[rid],
+            err_msg=f"replica {rid} diverged from replica 0",
+        )
+    assert np.isfinite(finals[0]).all()
+    # the soak is only meaningful if kills actually landed and healed
+    assert heal_count[0] >= 1, "chaos never produced a live heal"

@@ -45,8 +45,14 @@ def _run_demo(args: "list[str]", timeout: int) -> None:
             os.killpg(proc.pid, signal.SIGKILL)
         except ProcessLookupError:
             pass
-        proc.wait(timeout=30)
-        raise
+        # drain the pipes AFTER the kill so the failure report carries the
+        # demo's transcript (the wedge diagnosis), not just "TimeoutExpired"
+        stdout, stderr = proc.communicate()
+        raise AssertionError(
+            f"demo wedged past {timeout}s\n"
+            f"--- stdout ---\n{stdout[-4000:]}\n"
+            f"--- stderr ---\n{stderr[-4000:]}"
+        ) from None
     assert proc.returncode == 0, (
         f"demo failed rc={proc.returncode}\n"
         f"--- stdout ---\n{stdout[-4000:]}\n"
@@ -68,10 +74,15 @@ def test_train_ddp_demo_kill_and_recover():
 def test_train_llama_hsdp_demo():
     """Two replica groups x 4 virtual chips (fsdp/sp/tp in-group), FT on
     the replicated dim, one group killed and healed."""
+    # --kill-after below the test timeout so the demo's own wedge budget
+    # (kill sleep + per-replica wait) stays inside it and a wedge surfaces
+    # as the demo's rc=1 diagnostic instead of this test's timeout kill
     _run_demo(
         ["examples/train_llama_hsdp.py", "--demo", "--config", "debug",
-         "--steps", "4", "--batch-size", "4", "--seq-len", "64"],
-        timeout=420,
+         "--steps", "4", "--batch-size", "4", "--seq-len", "64",
+         "--kill-after", "8"],
+        # above the demo's own wedge budget (kill sleep + 600s replica wait)
+        timeout=700,
     )
 
 

@@ -154,8 +154,10 @@ def test_flax_model_trains_and_heals():
         for f in futs:
             f.result(timeout=180)
     finally:
-        # never join hung replica threads on the failure path — that would
-        # turn an assertion into a pytest hang
+        # don't join replica threads on the failure path; every wait inside
+        # the replica is bounded (barrier 30s, allreduce 30s, manager
+        # timeouts 10s), so workers exit on their own and the interpreter's
+        # atexit join cannot hang on them indefinitely
         ex.shutdown(wait=False, cancel_futures=True)
         lh.shutdown()
 

@@ -325,6 +325,71 @@ class TestQuorumHappyPath:
         assert m.errored() is None
         np.testing.assert_allclose(np.asarray(out["w"]), 2.0)  # 4 / 2
 
+    def test_timeouts_forwarded_to_rpcs(self):
+        """Reference test_quorum_happy_timeouts: the quorum RPC carries
+        quorum_timeout, the commit vote carries the op timeout — the
+        server-side deadline propagation contract."""
+        m = make_manager(quorum=make_quorum(), timeout=7.0, quorum_timeout=13.0)
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._test_client._quorum.call_args.kwargs["timeout"] == 13.0
+        assert m.should_commit()
+        assert m._test_client.should_commit.call_args.kwargs["timeout"] == 7.0
+
+    def test_quorum_no_healing_skips_recovery_but_counts(self):
+        """Reference test_quorum_no_healing: with allow_heal=False a
+        behind-the-cohort replica does NOT fetch a checkpoint, is not
+        participating, but the step still commits and counts the
+        participating cohort's batches."""
+        m = make_manager(
+            quorum=make_quorum(
+                heal=True, max_step=1, max_replica_rank=None,
+                recover_src_replica_rank=1,
+            ),
+        )
+        m.start_quorum(allow_heal=False)
+        out = m.allreduce({"x": np.ones(2, np.float32)}).get_future().wait(10)
+        np.testing.assert_allclose(out["x"], 0.0)  # zeros: not participating
+        assert not m.is_participating()
+        assert m.num_participants() == 2
+        assert m.should_commit()
+        assert m.current_step() == 1
+        assert m.batches_committed() == 2
+        # no checkpoint was fetched despite quorum.heal
+        assert not m._test_transport.recv_checkpoint.called
+
+    def test_allreduce_numerics_dtypes_and_ops(self):
+        """Reference manager_test.py test_manager_numerics: AVG normalizes
+        by num_participants for floating dtypes (incl. half/bfloat16);
+        SUM/MAX/MIN/PRODUCT pass through unnormalized; integer dtypes work
+        for the unnormalized ops; dtype survives the round trip."""
+        import jax.numpy as jnp
+
+        m = make_manager(quorum=make_quorum())  # num_participants == 2
+        m.start_quorum()
+        dtypes = [np.float16, jnp.bfloat16, np.float32, np.int64]
+        for dtype in dtypes:
+            orig = np.asarray([10], dtype=dtype)
+            if np.issubdtype(np.dtype(dtype), np.floating) or dtype is jnp.bfloat16:
+                out = m.allreduce({"x": orig}).get_future().wait(10)
+                got = np.asarray(out["x"])
+                assert got.dtype == np.dtype(dtype), (dtype, got.dtype)
+                np.testing.assert_allclose(
+                    got.astype(np.float32), [5.0]
+                )  # dummy PG world 1: sum == input, then / 2 participants
+            for op in (ReduceOp.SUM, ReduceOp.MAX, ReduceOp.MIN,
+                       ReduceOp.PRODUCT):
+                out = (
+                    m.allreduce({"x": orig}, reduce_op=op)
+                    .get_future()
+                    .wait(10)
+                )
+                got = np.asarray(out["x"])
+                assert got.dtype == np.dtype(dtype), (op, dtype, got.dtype)
+                np.testing.assert_allclose(
+                    got.astype(np.float32), [10.0], err_msg=str((op, dtype))
+                )
+
     def test_allreduce_sum_no_normalize(self):
         m = make_manager(quorum=make_quorum())
         m.start_quorum()

@@ -37,9 +37,9 @@ def run_parallel(world, fn):
         return [f.result(timeout=60) for f in futs]
 
 
-def make_pgs(store, world, quorum_id=1, timeout=10.0):
+def make_pgs(store, world, quorum_id=1, timeout=10.0, prefix="test"):
     pgs = [ProcessGroupHost(timeout=timeout) for _ in range(world)]
-    store_addr = f"127.0.0.1:{store.port}/test"
+    store_addr = f"127.0.0.1:{store.port}/{prefix}"
 
     def cfg(rank):
         pgs[rank].configure(store_addr, rank, world, quorum_id=quorum_id)
@@ -172,11 +172,39 @@ class TestProcessGroupHost:
         )
         pg.shutdown()
 
-    def test_resiliency_crash_and_reconfigure(self, store):
-        """Crash the last rank mid-life; survivors must observe an error and
-        then recover after reconfiguring to a smaller world."""
+    # per-collective issue fns for the resiliency matrix (reference
+    # process_group_test.py:963-1027 parametrizes its resiliency harness
+    # over every collective; an abort must fail and a reconfigure must
+    # revive each of them, not just allreduce)
+    _COLLECTIVES = {
+        "allreduce": lambda pg, rank, world: pg.allreduce(
+            [np.array([1.0])]
+        ),
+        "allgather": lambda pg, rank, world: pg.allgather(
+            [np.array([float(rank)])]
+        ),
+        "broadcast": lambda pg, rank, world: pg.broadcast(
+            [np.array([float(rank)])], root=0
+        ),
+        "reduce_scatter": lambda pg, rank, world: pg.reduce_scatter(
+            [[np.array([float(rank)])] for _ in range(world)]
+        ),
+        "alltoall": lambda pg, rank, world: pg.alltoall(
+            [np.array([float(rank * 10 + d)]) for d in range(world)]
+        ),
+        "barrier": lambda pg, rank, world: pg.barrier(),
+    }
+
+    @pytest.mark.parametrize("collective", sorted(_COLLECTIVES))
+    def test_resiliency_crash_and_reconfigure(self, store, collective):
+        """Crash the last rank mid-life; survivors must observe an error on
+        the given collective and then run it successfully after
+        reconfiguring to a smaller world."""
         world = 3
-        pgs = make_pgs(store, world, quorum_id=1, timeout=3.0)
+        issue = self._COLLECTIVES[collective]
+        pgs = make_pgs(
+            store, world, quorum_id=1, timeout=3.0, prefix=collective
+        )
 
         # Everyone agrees the mesh works.
         run_parallel(world, lambda r: pgs[r].barrier().wait())
@@ -186,23 +214,52 @@ class TestProcessGroupHost:
         def survivor_step(rank):
             if rank == 2:
                 return "crashed"
-            x = np.array([1.0])
+            # broadcast is root-push + ack: the dead rank is detected by the
+            # ROOT (missing ack); a live non-root receiver got its payload
+            # from the live root and legitimately completes. Every other
+            # collective rendezvouses all ranks, so every survivor errors.
+            if collective == "broadcast" and rank != 0:
+                try:
+                    issue(pgs[rank], rank, world).get_future().wait(timeout=10)
+                except Exception:  # noqa: BLE001 - either outcome is valid
+                    pass
+                return "errored"
             with pytest.raises(Exception):
-                pgs[rank].allreduce([x]).get_future().wait(timeout=10)
+                issue(pgs[rank], rank, world).get_future().wait(timeout=10)
             return "errored"
 
         assert run_parallel(world, survivor_step) == ["errored", "errored", "crashed"]
         assert pgs[0].errored() is not None
 
-        # Reconfigure survivors under a new quorum id with world=2.
+        # Reconfigure survivors under a new quorum id with world=2; the
+        # same collective must complete WITH world-2 values (a generation
+        # that leaked state from the aborted world-3 mesh, or reduced with
+        # the wrong world size, must fail here, not just hang).
         def recfg(rank):
-            pgs[rank].configure(f"127.0.0.1:{store.port}/test", rank, 2, quorum_id=2)
-            x = np.array([float(rank + 1)])
-            return pgs[rank].allreduce([x]).get_future().wait()[0]
+            pgs[rank].configure(
+                f"127.0.0.1:{store.port}/test_{collective}", rank, 2,
+                quorum_id=2,
+            )
+            return issue(pgs[rank], rank, 2).get_future().wait(timeout=10)
 
         outs = run_parallel(2, recfg)
-        for out in outs:
-            np.testing.assert_allclose(out, [3.0])
+        if collective == "allreduce":  # both contribute [1.0]
+            for out in outs:
+                np.testing.assert_allclose(out[0], [2.0])
+        elif collective == "allgather":  # rows = [rank0 leaves, rank1 leaves]
+            for out in outs:
+                np.testing.assert_allclose(out[0][0], [0.0])
+                np.testing.assert_allclose(out[1][0], [1.0])
+        elif collective == "broadcast":  # root 0's payload everywhere
+            for out in outs:
+                np.testing.assert_allclose(out[0], [0.0])
+        elif collective == "reduce_scatter":  # chunk r reduced over 2 ranks
+            for rank, out in enumerate(outs):
+                np.testing.assert_allclose(out[0], [0.0 + 1.0])
+        elif collective == "alltoall":  # out[src] = src's chunk for me
+            for rank, out in enumerate(outs):
+                np.testing.assert_allclose(out[0], [0.0 * 10 + rank])
+                np.testing.assert_allclose(out[1], [1.0 * 10 + rank])
         assert pgs[0].errored() is None
         for pg in pgs[:2]:
             pg.shutdown()

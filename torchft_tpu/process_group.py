@@ -822,8 +822,20 @@ class ProcessGroupHost(ProcessGroup):
                 for peer in range(comm.world):
                     if peer != comm.rank:
                         comm.send_to(peer, host)
+                # ack round-trip makes broadcast a real collective: a small
+                # payload to a dead peer can land in the kernel buffer and
+                # "succeed", leaving the root blind to the failure — NCCL-
+                # class broadcasts are communicator-wide and error on a dead
+                # rank, and the resiliency matrix relies on that contract
+                for peer in range(comm.world):
+                    if peer != comm.rank:
+                        ack = comm.recv_from(peer)
+                        if ack != ("bcast_ack", peer):
+                            raise RuntimeError(f"bad broadcast ack: {ack!r}")
                 return host
-            return comm.recv_from(root)
+            out = comm.recv_from(root)
+            comm.send_to(root, ("bcast_ack", comm.rank))
+            return out
 
         return self._submit(_run, "broadcast")
 

@@ -31,7 +31,16 @@ import numpy as np  # noqa: E402
 
 
 def _rss_mb() -> float:
-    # ru_maxrss is KiB on Linux, bytes on macOS
+    """Peak RSS of THIS process. VmHWM, not ru_maxrss: on Linux ru_maxrss
+    survives fork+exec, so a subprocess inherits its parent's peak and the
+    two-process bench would report a zero receiver delta."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM"):
+                    return int(line.split()[1]) / 1024  # KiB -> MiB
+    except OSError:
+        pass
     div = 1 << 20 if sys.platform == "darwin" else 1024
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
 
@@ -110,6 +119,78 @@ def bench_pg(state: dict, inplace: bool, timeout: float) -> float:
         for pg in pgs:
             pg.shutdown()
         store.shutdown()
+
+
+def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> None:
+    """Per-SIDE peak RSS (the streaming bound is ~1x payload + one leaf per
+    side; the single-process bench necessarily shows ~2x because both ends
+    share one address space). Parent stages + serves; a fresh child fetches
+    and reports its own delta."""
+    import subprocess
+
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    state = make_state(size_mb)
+    payload_mb = sum(v.nbytes for v in state.values()) / 2**20
+    rss_before_stage = _rss_mb()
+    send = HTTPTransport(timeout=timeout, num_chunks=num_chunks)
+    try:
+        send.send_checkpoint(
+            dst_ranks=[1], step=1, state_dict={"user": state}, timeout=timeout
+        )
+        sender_delta = _rss_mb() - rss_before_stage
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--transport",
+                 "http", "--size-mb", str(size_mb),
+                 "--num-chunks", str(num_chunks),
+                 "--timeout", str(timeout), "--_recv-child", send.metadata()],
+                capture_output=True, text=True,
+                # budget beyond the fetch timeout: interpreter/numpy startup
+                # and the post-measurement payload verification
+                timeout=timeout + 120,
+            )
+        except subprocess.TimeoutExpired as e:
+            sys.exit(f"recv child wedged past {timeout + 120}s:\n"
+                     f"{(e.stderr or b'')[-2000:]}")
+        if child.returncode != 0:
+            sys.exit(f"recv child failed:\n{child.stderr[-2000:]}")
+        recv_stats = json.loads(child.stdout.strip().splitlines()[-1])
+    finally:
+        send.shutdown()
+    print(json.dumps({
+        "transport": "http-2proc",
+        "size_mb": size_mb,
+        "seconds": recv_stats["seconds"],
+        "gb_per_s": round(size_mb / 1024 / recv_stats["seconds"], 3),
+        "sender_stage_rss_x_payload": round(sender_delta / payload_mb, 2),
+        "receiver_rss_x_payload": round(
+            recv_stats["rss_delta_mb"] / payload_mb, 2
+        ),
+    }), flush=True)
+
+
+def _recv_child(metadata: str, size_mb: int, num_chunks: int, timeout: float) -> None:
+    """Receiver half of the two-process bench: fetch, verify, report RSS."""
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    recv = HTTPTransport(timeout=timeout, num_chunks=num_chunks)
+    try:
+        rss0 = _rss_mb()
+        t0 = time.perf_counter()
+        got = recv.recv_checkpoint(
+            src_rank=0, metadata=metadata, step=1, timeout=timeout
+        )
+        dt = time.perf_counter() - t0
+        delta = _rss_mb() - rss0
+    finally:
+        recv.shutdown()
+    # verify content cheaply: make_state seeds RandomState(0) and layer_0
+    # is its first draw, so the first 64 values match regardless of total
+    # size — no need to regenerate the multi-GB payload post-measurement
+    expect = np.random.RandomState(0).randn(64).astype(np.float32)
+    np.testing.assert_array_equal(got["user"]["layer_0"][:64], expect)
+    print(json.dumps({"seconds": round(dt, 3), "rss_delta_mb": round(delta, 1)}))
 
 
 def bench_allreduce(size_mb: int, timeout: float) -> None:
@@ -193,10 +274,23 @@ def main() -> None:
     parser.add_argument("--inplace", action="store_true",
                         help="pg: receive into a preallocated template")
     parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--two-process", action="store_true",
+                        help="http: sender and receiver in separate "
+                             "processes, per-side peak RSS")
+    parser.add_argument("--_recv-child", default="", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
+    if args._recv_child:
+        _recv_child(args._recv_child, args.size_mb, args.num_chunks,
+                    args.timeout)
+        return
     if args.transport == "allreduce":
         bench_allreduce(args.size_mb, args.timeout)
+        return
+    if args.two_process:
+        if args.transport != "http":
+            sys.exit("--two-process supports http only")
+        bench_http_two_process(args.size_mb, args.num_chunks, args.timeout)
         return
 
     state = make_state(args.size_mb)

@@ -121,6 +121,95 @@ def bench_pg(state: dict, inplace: bool, timeout: float) -> float:
         store.shutdown()
 
 
+def bench_pg_two_process(size_mb: int, timeout: float) -> None:
+    """Per-side RSS for the PG transport: parent = rank 0 sender, child =
+    rank 1 receiver, each its own process over a shared KV store."""
+    import subprocess
+
+    from torchft_tpu.checkpointing import PGTransport
+    from torchft_tpu.coordination import KvStoreServer
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    state = make_state(size_mb)
+    payload_mb = sum(v.nbytes for v in state.values()) / 2**20
+    store = KvStoreServer("127.0.0.1:0")
+    addr = f"127.0.0.1:{store.port}/bench2p"
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--transport", "pg",
+         "--size-mb", str(size_mb), "--timeout", str(timeout),
+         "--_recv-child", f"pg:{addr}"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    pg = ProcessGroupHost(timeout=timeout)
+    sender = PGTransport(pg, timeout=timeout)
+    try:
+        rss_before = _rss_mb()
+        pg.configure(addr, 0, 2, quorum_id=1)  # rendezvous with the child
+        sender.send_checkpoint(
+            dst_ranks=[1], step=1, state_dict={"user": state}, timeout=timeout
+        )
+        sender_delta = _rss_mb() - rss_before
+        try:
+            out, err = child.communicate(timeout=timeout + 120)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            out, err = child.communicate()
+            sys.exit(f"pg recv child wedged:\n{err[-2000:]}")
+        if child.returncode != 0:
+            sys.exit(f"pg recv child failed:\n{err[-2000:]}")
+        recv_stats = json.loads(out.strip().splitlines()[-1])
+    finally:
+        # a parent-side failure (configure timeout, send error) must not
+        # orphan the child blocked in recv for its full timeout
+        if child.poll() is None:
+            child.kill()
+            child.communicate()
+        sender.shutdown()
+        pg.shutdown()
+        store.shutdown()
+    print(json.dumps({
+        "transport": "pg-2proc",
+        "size_mb": size_mb,
+        "seconds": recv_stats["seconds"],
+        "gb_per_s": round(size_mb / 1024 / recv_stats["seconds"], 3),
+        "sender_send_rss_x_payload": round(sender_delta / payload_mb, 2),
+        "receiver_rss_x_payload": round(
+            recv_stats["rss_delta_mb"] / payload_mb, 2
+        ),
+    }), flush=True)
+
+
+def _verify_and_report_recv(got: dict, dt: float, delta: float) -> None:
+    """Shared tail of both recv children: verify content cheaply (make_state
+    seeds RandomState(0) and layer_0 is its first draw, so the first 64
+    values match regardless of total size — no multi-GB regeneration after
+    the measurement), then print the stats the parent parses."""
+    expect = np.random.RandomState(0).randn(64).astype(np.float32)
+    np.testing.assert_array_equal(got["user"]["layer_0"][:64], expect)
+    print(json.dumps({"seconds": round(dt, 3), "rss_delta_mb": round(delta, 1)}))
+
+
+def _pg_recv_child(addr: str, size_mb: int, timeout: float) -> None:
+    from torchft_tpu.checkpointing import PGTransport
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    pg = ProcessGroupHost(timeout=timeout)
+    recv = PGTransport(pg, timeout=timeout)
+    try:
+        pg.configure(addr, 1, 2, quorum_id=1)
+        rss0 = _rss_mb()
+        t0 = time.perf_counter()
+        got = recv.recv_checkpoint(
+            src_rank=0, metadata=recv.metadata(), step=1, timeout=timeout
+        )
+        dt = time.perf_counter() - t0
+        delta = _rss_mb() - rss0
+    finally:
+        recv.shutdown()
+        pg.shutdown()
+    _verify_and_report_recv(got, dt, delta)
+
+
 def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> None:
     """Per-SIDE peak RSS (the streaming bound is ~1x payload + one leaf per
     side; the single-process bench necessarily shows ~2x because both ends
@@ -151,8 +240,10 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> Non
                 timeout=timeout + 120,
             )
         except subprocess.TimeoutExpired as e:
-            sys.exit(f"recv child wedged past {timeout + 120}s:\n"
-                     f"{(e.stderr or b'')[-2000:]}")
+            err = e.stderr or b""
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            sys.exit(f"recv child wedged past {timeout + 120}s:\n{err[-2000:]}")
         if child.returncode != 0:
             sys.exit(f"recv child failed:\n{child.stderr[-2000:]}")
         recv_stats = json.loads(child.stdout.strip().splitlines()[-1])
@@ -185,12 +276,7 @@ def _recv_child(metadata: str, size_mb: int, num_chunks: int, timeout: float) ->
         delta = _rss_mb() - rss0
     finally:
         recv.shutdown()
-    # verify content cheaply: make_state seeds RandomState(0) and layer_0
-    # is its first draw, so the first 64 values match regardless of total
-    # size — no need to regenerate the multi-GB payload post-measurement
-    expect = np.random.RandomState(0).randn(64).astype(np.float32)
-    np.testing.assert_array_equal(got["user"]["layer_0"][:64], expect)
-    print(json.dumps({"seconds": round(dt, 3), "rss_delta_mb": round(delta, 1)}))
+    _verify_and_report_recv(got, dt, delta)
 
 
 def bench_allreduce(size_mb: int, timeout: float) -> None:
@@ -275,22 +361,26 @@ def main() -> None:
                         help="pg: receive into a preallocated template")
     parser.add_argument("--timeout", type=float, default=600.0)
     parser.add_argument("--two-process", action="store_true",
-                        help="http: sender and receiver in separate "
+                        help="http/pg: sender and receiver in separate "
                              "processes, per-side peak RSS")
     parser.add_argument("--_recv-child", default="", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
     if args._recv_child:
-        _recv_child(args._recv_child, args.size_mb, args.num_chunks,
-                    args.timeout)
+        if args._recv_child.startswith("pg:"):
+            _pg_recv_child(args._recv_child[3:], args.size_mb, args.timeout)
+        else:
+            _recv_child(args._recv_child, args.size_mb, args.num_chunks,
+                        args.timeout)
         return
     if args.transport == "allreduce":
         bench_allreduce(args.size_mb, args.timeout)
         return
     if args.two_process:
-        if args.transport != "http":
-            sys.exit("--two-process supports http only")
-        bench_http_two_process(args.size_mb, args.num_chunks, args.timeout)
+        if args.transport == "http":
+            bench_http_two_process(args.size_mb, args.num_chunks, args.timeout)
+        else:  # "pg" — argparse choices exclude everything else
+            bench_pg_two_process(args.size_mb, args.timeout)
         return
 
     state = make_state(args.size_mb)

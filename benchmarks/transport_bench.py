@@ -121,9 +121,10 @@ def bench_pg(state: dict, inplace: bool, timeout: float) -> float:
         store.shutdown()
 
 
-def bench_pg_two_process(size_mb: int, timeout: float) -> None:
+def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool) -> None:
     """Per-side RSS for the PG transport: parent = rank 0 sender, child =
-    rank 1 receiver, each its own process over a shared KV store."""
+    rank 1 receiver, each its own process over a shared KV store. With
+    ``inplace`` the child preallocates a template and receives into it."""
     import subprocess
 
     from torchft_tpu.checkpointing import PGTransport
@@ -137,6 +138,7 @@ def bench_pg_two_process(size_mb: int, timeout: float) -> None:
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--transport", "pg",
          "--size-mb", str(size_mb), "--timeout", str(timeout),
+         *(["--inplace"] if inplace else []),
          "--_recv-child", f"pg:{addr}"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
@@ -170,6 +172,7 @@ def bench_pg_two_process(size_mb: int, timeout: float) -> None:
     print(json.dumps({
         "transport": "pg-2proc",
         "size_mb": size_mb,
+        "inplace": inplace,
         "seconds": recv_stats["seconds"],
         "gb_per_s": round(size_mb / 1024 / recv_stats["seconds"], 3),
         "sender_send_rss_x_payload": round(sender_delta / payload_mb, 2),
@@ -189,12 +192,19 @@ def _verify_and_report_recv(got: dict, dt: float, delta: float) -> None:
     print(json.dumps({"seconds": round(dt, 3), "rss_delta_mb": round(delta, 1)}))
 
 
-def _pg_recv_child(addr: str, size_mb: int, timeout: float) -> None:
+def _pg_recv_child(addr: str, size_mb: int, timeout: float, inplace: bool) -> None:
     from torchft_tpu.checkpointing import PGTransport
     from torchft_tpu.process_group import ProcessGroupHost
 
+    template = (
+        {"user": {k: np.zeros_like(v) for k, v in make_state(size_mb).items()}}
+        if inplace else None
+    )
     pg = ProcessGroupHost(timeout=timeout)
-    recv = PGTransport(pg, timeout=timeout)
+    recv = PGTransport(
+        pg, timeout=timeout,
+        state_dict_template=(lambda: template) if inplace else None,
+    )
     try:
         pg.configure(addr, 1, 2, quorum_id=1)
         rss0 = _rss_mb()
@@ -368,7 +378,8 @@ def main() -> None:
 
     if args._recv_child:
         if args._recv_child.startswith("pg:"):
-            _pg_recv_child(args._recv_child[3:], args.size_mb, args.timeout)
+            _pg_recv_child(args._recv_child[3:], args.size_mb, args.timeout,
+                           args.inplace)
         else:
             _recv_child(args._recv_child, args.size_mb, args.num_chunks,
                         args.timeout)
@@ -380,7 +391,7 @@ def main() -> None:
         if args.transport == "http":
             bench_http_two_process(args.size_mb, args.num_chunks, args.timeout)
         else:  # "pg" — argparse choices exclude everything else
-            bench_pg_two_process(args.size_mb, args.timeout)
+            bench_pg_two_process(args.size_mb, args.timeout, args.inplace)
         return
 
     state = make_state(args.size_mb)

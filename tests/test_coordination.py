@@ -277,6 +277,59 @@ class TestLighthouseManagerE2E:
             lh.shutdown()
 
 
+class TestWireRobustness:
+    """Garbage on the control-plane sockets must never take the server
+    down: a crash here kills coordination for the whole job. The server
+    should drop the bad connection and keep serving valid clients."""
+
+    def test_lighthouse_survives_malformed_frames(self):
+        import random
+        import socket
+        import struct
+
+        from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        )
+        rng = random.Random(7)
+        try:
+            payloads = [
+                b"",  # connect + close
+                b"\x00" * 4,  # zero-length frame
+                struct.pack(">I", 2**31) + b"x",  # absurd declared length
+                b"GET / HTTP/1.1\r\n\r\n",  # wrong protocol (HTTP on RPC port? same port serves both here)
+                struct.pack(">I", 8) + b"notjson!",  # framed garbage
+                bytes(rng.randrange(256) for _ in range(64)),  # noise
+            ]
+            for payload in payloads:
+                s = socket.create_connection(("127.0.0.1", lh.port), timeout=5)
+                try:
+                    s.sendall(payload)
+                    s.settimeout(1.0)
+                    try:
+                        s.recv(4096)  # server may answer or just close
+                    except OSError:
+                        pass
+                finally:
+                    s.close()
+
+            # the server must still serve a real client
+            client = LighthouseClient(
+                f"127.0.0.1:{lh.port}", connect_timeout=5.0
+            )
+            client.heartbeat("robust_replica", timeout=5.0)
+            q = client.quorum(
+                replica_id="robust_replica", timeout=10.0,
+            )
+            assert any(
+                m.replica_id == "robust_replica" for m in q.participants
+            )
+        finally:
+            lh.shutdown()
+
+
 class TestDashboard:
     """Lighthouse HTTP dashboard (reference: src/lighthouse.rs routes /,
     /status, /replica/:id/kill serving HTML + JSON + kill buttons)."""

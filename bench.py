@@ -93,31 +93,21 @@ def fault_tolerance_metrics(size_mb: int = 8, steps: int = 12, kill_at: int = 4)
 
 
 def _probe_accelerator(timeout_s: float = 240.0) -> str:
-    """Report what backend init actually does — probed in a SUBPROCESS.
+    """Report what backend init actually does — probed in a SUBPROCESS
+    (torchft_tpu.utils.probe_backend, shared with the doctor CLI).
 
     Returns "accel" (an accelerator initializes), "cpu" (backend init works
     but only CPU is present — a legitimate dev-box baseline), "crash"
-    (backend init fails fast — broken install/driver; stderr is printed),
+    (backend init fails fast — broken install/driver; detail is printed),
     or "hung" (init never returned: the wedged-TPU-tunnel mode that made
     round 1's bench emit nothing). Must run before any jax import/use here.
     """
-    import subprocess
+    from torchft_tpu.utils import probe_backend
 
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-        if out.returncode == 0 and out.stdout.strip() not in ("", "cpu"):
-            return "accel"
-        if out.returncode == 0:
-            return "cpu"
-        print(f"# accelerator probe crashed:\n{out.stderr[-2000:]}",
-              file=sys.stderr)
-        return "crash"
-    except subprocess.TimeoutExpired:
-        return "hung"
+    status, detail = probe_backend(timeout_s)
+    if status == "crash":
+        print(f"# accelerator probe crashed:\n{detail}", file=sys.stderr)
+    return status
 
 
 def main() -> None:

@@ -325,6 +325,29 @@ class TestQuorumHappyPath:
         assert m.errored() is None
         np.testing.assert_allclose(np.asarray(out["w"]), 2.0)  # 4 / 2
 
+    def test_metrics_counters(self):
+        m = make_manager(quorum=make_quorum())
+        assert m.metrics() == {
+            "quorums": 0, "reconfigures": 0, "heals": 0, "commits": 0,
+            "commit_failures": 0, "allreduces": 0, "errors": 0,
+        }
+        m.start_quorum()
+        m.allreduce({"w": np.ones(2, np.float32)}).get_future().wait(10)
+        assert m.should_commit()
+        got = m.metrics()
+        assert got["quorums"] == 1
+        assert got["reconfigures"] == 1  # quorum_id -1 -> 1
+        assert got["allreduces"] == 1
+        assert got["commits"] == 1
+        assert got["commit_failures"] == 0 and got["errors"] == 0
+        m.start_quorum()  # clears the per-step error state first
+        m.report_error(RuntimeError("boom"))
+        assert not m.should_commit()  # errored step is discarded
+        got = m.metrics()
+        assert got["errors"] == 1
+        assert got["commit_failures"] == 1
+        assert got["commits"] == 1  # unchanged
+
     def test_timeouts_forwarded_to_rpcs(self):
         """Reference test_quorum_happy_timeouts: the quorum RPC carries
         quorum_timeout, the commit vote carries the op timeout — the

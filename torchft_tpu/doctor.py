@@ -1,0 +1,128 @@
+"""Environment diagnostic: ``python -m torchft_tpu.doctor``.
+
+One command an operator runs on a fresh host (or in a wedged job's
+postmortem) to answer "is this machine able to run a torchft_tpu replica
+group right now": native control plane builds and serves, JAX backend
+initializes (with a subprocess probe so a wedged TPU tunnel reports as
+WEDGED instead of hanging the doctor — the failure mode bench.py's
+`_probe_accelerator` exists for), the virtual multi-device CPU mesh works
+(what tests and dryruns rely on), and a lighthouse round-trip completes.
+
+Exit code 0 iff every check passes (the accelerator check passes as
+"cpu-only" — a legitimate dev box). Prints one line per check:
+
+    ok   native          built (.../libtorchft_tpu.so)
+    ok   accelerator     tpu (1 device)
+    ...
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Callable, List, Tuple
+
+# (status, detail); status: True=ok, False=fail, None=warn
+Result = Tuple["bool | None", str]
+
+
+def check_native() -> Result:
+    try:
+        from torchft_tpu.coordination import ensure_native_built
+
+        return True, f"built ({ensure_native_built()})"
+    except Exception as e:  # noqa: BLE001
+        return False, f"native build/load failed: {e}"
+
+
+def check_accelerator(timeout_s: float = 60.0) -> Result:
+    """Subprocess probe: a wedged TPU tunnel hangs backend init forever."""
+    from torchft_tpu.utils import probe_backend
+
+    status, detail = probe_backend(timeout_s)
+    if status == "hung":
+        return False, (
+            f"{detail} — wedged accelerator tunnel? (CPU-only work still "
+            "fine via force_virtual_cpu_devices)"
+        )
+    if status == "crash":
+        return False, f"backend init crashed: {detail}"
+    if status == "cpu":
+        return None, "cpu only (no accelerator — fine for a dev box)"
+    return True, detail
+
+
+def check_virtual_mesh(timeout_s: float = 120.0) -> Result:
+    """The 8-device CPU mesh that tests/dryruns use."""
+    code = (
+        "from torchft_tpu.utils import force_virtual_cpu_devices\n"
+        "force_virtual_cpu_devices(8)\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "import numpy as np\n"
+        "mesh = Mesh(np.array(jax.devices()[:8]), ('x',))\n"
+        "y = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P('x')))\n"
+        "assert float(y.sum()) == 28.0\n"
+        "print('ok')\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"virtual mesh hung >{timeout_s:.0f}s"
+    if out.returncode != 0:
+        return False, f"virtual mesh failed: {out.stderr.strip()[-200:]}"
+    return True, "8-device CPU mesh shards + reduces"
+
+
+def check_lighthouse_roundtrip() -> Result:
+    try:
+        from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        )
+        try:
+            client = LighthouseClient(
+                f"127.0.0.1:{lh.port}", connect_timeout=5.0
+            )
+            client.heartbeat("doctor", timeout=5.0)
+            q = client.quorum(replica_id="doctor", timeout=10.0)
+            ok = any(m.replica_id == "doctor" for m in q.participants)
+            return (True, f"quorum_id={q.quorum_id} formed") if ok else (
+                False, "quorum formed without this replica"
+            )
+        finally:
+            lh.shutdown()
+    except Exception as e:  # noqa: BLE001
+        return False, f"lighthouse round-trip failed: {e}"
+
+
+CHECKS: List[Tuple[str, Callable[[], Result]]] = [
+    ("native", check_native),
+    ("accelerator", check_accelerator),
+    ("virtual-mesh", check_virtual_mesh),
+    ("lighthouse", check_lighthouse_roundtrip),
+]
+
+
+def main() -> None:
+    failed = False
+    for name, fn in CHECKS:
+        try:
+            status, detail = fn()
+        except Exception as e:  # noqa: BLE001 - a crashing check is a failure
+            status, detail = False, f"check crashed: {e}"
+        tag = {True: "ok  ", None: "warn", False: "FAIL"}[status]
+        print(f"{tag} {name:<14} {detail}", flush=True)
+        failed |= status is False
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

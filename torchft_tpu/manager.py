@@ -252,6 +252,18 @@ class Manager:
         self._batches_committed = 0
         self._commit_failures = 0
         self._errored: Optional[ExceptionWithTraceback] = None
+        # lifetime counters for metrics() — monotonic, never reset (unlike
+        # _commit_failures, which is the protocol's CONSECUTIVE counter)
+        self._metrics_lock = threading.Lock()
+        self._metrics: Dict[str, int] = {
+            "quorums": 0,
+            "reconfigures": 0,
+            "heals": 0,
+            "commits": 0,
+            "commit_failures": 0,
+            "allreduces": 0,
+            "errors": 0,
+        }
         self._healing = False
         self._last_quorum_healed = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
@@ -371,6 +383,7 @@ class Manager:
             return
 
         self._num_replicas = quorum.replica_world_size
+        self._bump_metric("quorums")
 
         # Participation (reference: manager.py:671-690): async quorum means
         # healing replicas sit this step out, so the participating world is
@@ -413,6 +426,7 @@ class Manager:
             )
             try:
                 self._quorum_id = quorum.quorum_id
+                self._bump_metric("reconfigures")
                 with trace_span("torchft::manager::_pg::configure"):
                     self._pg.configure(
                         store_prefixed_addr,
@@ -490,6 +504,7 @@ class Manager:
                     load_fn(user[key])
             self._pending_state_dict = None
         self._last_quorum_healed = True
+        self._bump_metric("heals")
 
     # ------------------------------------------------------------ allreduce
     @traced("torchft::manager::allreduce")
@@ -508,6 +523,7 @@ class Manager:
         """
         import jax
 
+        self._bump_metric("allreduces")
         leaves, treedef = jax.tree_util.tree_flatten(values)
 
         def rebuild(host_leaves: List[np.ndarray]) -> Any:
@@ -749,10 +765,31 @@ class Manager:
             self.report_error(e)
             return DummyWork(zeros())
 
+    # ------------------------------------------------------------ metrics
+    def _bump_metric(self, name: str) -> None:
+        with self._metrics_lock:
+            self._metrics[name] += 1
+
+    def metrics(self) -> Dict[str, int]:
+        """Lifetime counters for operators/tests: quorums completed,
+        PG reconfigures, live heals applied, commits, commit failures
+        (monotonic total, unlike the protocol's consecutive
+        ``_commit_failures``), allreduce calls, and errors reported. The
+        structured event streams (observability.py) log the same moments
+        as events; this is the cheap queryable aggregate."""
+        with self._metrics_lock:
+            return dict(self._metrics)
+
     # ------------------------------------------------------------- errors
     def report_error(self, e: Exception) -> None:
         """Mark the step as corrupt; it will be discarded at should_commit
         and the PG reconfigured on the next quorum."""
+        # count error EPISODES, not report_error calls: one wire fault fans
+        # out into a report per in-flight allreduce plus one per commit vote
+        # while the PG stays errored — operators comparing this against
+        # commit_failures need fault frequency, not callback fan-out
+        if self._errored is None:
+            self._bump_metric("errors")
         self._errored = ExceptionWithTraceback(e)
         from torchft_tpu.flight_recorder import recorder
 
@@ -865,8 +902,10 @@ class Manager:
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
+            self._bump_metric("commits")
         else:
             self._commit_failures += 1
+            self._bump_metric("commit_failures")
             if (
                 self._max_retries is not None
                 and self._commit_failures > self._max_retries

@@ -16,6 +16,39 @@ from typing import Any
 _FLAG = "xla_force_host_platform_device_count"
 
 
+def probe_backend(timeout_s: float = 60.0) -> "tuple[str, str]":
+    """Probe the default JAX backend in a SUBPROCESS; (status, detail).
+
+    status: "accel" (an accelerator initializes), "cpu" (init works, CPU
+    only), "crash" (init fails fast), "hung" (init never returned — the
+    wedged-tunnel mode). The subprocess is the point: a wedged platform
+    plugin hangs backend init forever, and only a killable child turns
+    that into a bounded, reportable answer. Shared by bench.py's
+    pre-flight probe and ``python -m torchft_tpu.doctor``.
+    """
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print('PROBE', jax.default_backend(), len(d))"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return "hung", f"backend init hung >{timeout_s:.0f}s"
+    if out.returncode != 0:
+        return "crash", out.stderr.strip()[-300:]
+    # scan for the sentinel line: runtimes love writing log lines to stdout
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("PROBE "):
+            _, backend, n = line.split()
+            status = "cpu" if backend == "cpu" else "accel"
+            return status, f"{backend} ({n} device(s))"
+    return "crash", f"probe printed no result: {out.stdout[-200:]!r}"
+
+
 def force_virtual_cpu_devices(n: int) -> None:
     """Force a virtual ``n``-device CPU platform.
 

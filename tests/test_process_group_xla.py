@@ -230,6 +230,7 @@ class TestLocalMode:
             num_participants = lambda self: world
             is_participating = lambda self: True
             report_error = lambda self, e: None
+            _bump_metric = lambda self, name: None
 
             def wrap_future(self, fut, default, **kwargs):
                 return fut

@@ -64,12 +64,16 @@ def test_manager_events_emitted_on_report_error(caplog):
     from torchft_tpu.manager import Manager
 
     # Construct a Manager shell without running __init__ networking.
+    import threading
+
     m = Manager.__new__(Manager)
     m._errored = None
     m._replica_id = "test:0"
     m._group_rank = 0
     m._step = 5
     m._quorum_id = 2
+    m._metrics_lock = threading.Lock()
+    m._metrics = {"errors": 0}
 
     with caplog.at_level(logging.INFO, logger=ERROR_EVENTS):
         m.report_error(RuntimeError("injected"))

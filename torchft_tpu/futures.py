@@ -24,7 +24,7 @@ import sys
 import threading
 from contextlib import contextmanager
 from datetime import timedelta
-from typing import Any, Callable, Generator, Optional, TypeVar
+from typing import Callable, Generator, Optional, TypeVar
 
 from torchft_tpu.work import Future
 

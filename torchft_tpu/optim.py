@@ -9,7 +9,7 @@ only when the commit vote succeeds, otherwise returns the inputs unchanged
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import optax
 

@@ -192,24 +192,63 @@ class TestLocalMode:
         np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(2, 3.0))
         assert survivors[0]._world.mesh.shape["replica"] == 3
 
-    def test_abort_fails_pending_and_errors(self, store):
+    # abort -> fail -> reconfigure -> succeed, for every collective (the
+    # host plane has the same matrix in test_process_group.py; the device
+    # plane's rendezvous/slot machinery must honor the identical contract)
+    _COLLECTIVES = {
+        "allreduce": lambda pg, rank, world: pg.allreduce(
+            [jnp.ones(2)], ReduceOp.SUM
+        ),
+        "allgather": lambda pg, rank, world: pg.allgather(
+            [jnp.full((2,), float(rank))]
+        ),
+        "broadcast": lambda pg, rank, world: pg.broadcast(
+            [jnp.full((2,), float(rank))], root=0
+        ),
+        "reduce_scatter": lambda pg, rank, world: pg.reduce_scatter(
+            [[jnp.full((2,), float(rank))] for _ in range(world)],
+            ReduceOp.SUM,
+        ),
+        "alltoall": lambda pg, rank, world: pg.alltoall(
+            [jnp.full((2,), float(rank * 10 + d)) for d in range(world)]
+        ),
+    }
+
+    @pytest.mark.parametrize("collective", sorted(_COLLECTIVES))
+    def test_abort_reconfigure_matrix(self, store, collective):
         world = 2
+        issue = self._COLLECTIVES[collective]
         pgs = make_pgs(store, world)
-        # rank 0 deposits; rank 1 never arrives; abort must fail rank 0's op
-        work = pgs[0].allreduce([jnp.ones(2)], ReduceOp.SUM)
+        # rank 0 deposits; rank 1 aborts instead of arriving
+        work = issue(pgs[0], 0, world)
         pgs[1].abort()
         with pytest.raises(RuntimeError, match="aborted"):
             work.get_future().wait(10)
         assert pgs[0].errored() is not None
-        # reconfigure clears the error (fresh quorum id -> fresh world)
-        addr = f"127.0.0.1:{store.port}/xla"
-        run_parallel(2, lambda r: pgs[r].configure(addr, r, 2, 3))
+
+        addr = f"127.0.0.1:{store.port}/xla_{collective}"
+        run_parallel(world, lambda r: pgs[r].configure(addr, r, world, 9))
         assert pgs[0].errored() is None
         outs = run_parallel(
-            2,
-            lambda r: pgs[r].allreduce([jnp.ones(2)]).get_future().wait(30),
+            world,
+            lambda r: issue(pgs[r], r, world).get_future().wait(30),
         )
-        np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(2, 2.0))
+        # value checks: the fresh generation must compute, not just return
+        if collective == "allreduce":
+            np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(2, 2.0))
+        elif collective == "allgather":
+            np.testing.assert_allclose(np.asarray(outs[0][0][0]), 0.0)
+            np.testing.assert_allclose(np.asarray(outs[0][1][0]), 1.0)
+        elif collective == "broadcast":
+            for out in outs:
+                np.testing.assert_allclose(np.asarray(out[0]), 0.0)
+        elif collective == "reduce_scatter":
+            for rank, out in enumerate(outs):
+                np.testing.assert_allclose(np.asarray(out[0]), 1.0)  # 0+1
+        elif collective == "alltoall":
+            for rank, out in enumerate(outs):
+                np.testing.assert_allclose(np.asarray(out[0]), 0.0 + rank)
+                np.testing.assert_allclose(np.asarray(out[1]), 10.0 + rank)
 
     def test_manager_allreduce_stays_on_device(self, store):
         """Manager.allreduce with a device-native PG: no host staging, the

@@ -92,34 +92,19 @@ def fault_tolerance_metrics(size_mb: int = 8, steps: int = 12, kill_at: int = 4)
     }
 
 
-def _probe_accelerator(timeout_s: float = 240.0) -> str:
-    """Report what backend init actually does — probed in a SUBPROCESS
-    (torchft_tpu.utils.probe_backend, shared with the doctor CLI).
-
-    Returns "accel" (an accelerator initializes), "cpu" (backend init works
-    but only CPU is present — a legitimate dev-box baseline), "crash"
-    (backend init fails fast — broken install/driver; detail is printed),
-    or "hung" (init never returned: the wedged-TPU-tunnel mode that made
-    round 1's bench emit nothing). Must run before any jax import/use here.
-    """
-    from torchft_tpu.utils import probe_backend
-
-    status, detail = probe_backend(timeout_s)
-    if status == "crash":
-        print(f"# accelerator probe crashed:\n{detail}", file=sys.stderr)
-    return status
-
-
 def main() -> None:
-    probe = _probe_accelerator()
+    # shared fallback policy (ensure_responsive_backend): one probe, one
+    # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
+    from torchft_tpu.utils import ensure_responsive_backend
+
+    probe, probe_detail = ensure_responsive_backend()
+    if probe == "crash":
+        print(f"# accelerator probe crashed:\n{probe_detail}", file=sys.stderr)
     if probe in ("hung", "crash"):
-        # backend init would hang/crash this process too; force the CPU
-        # platform so a (degraded, clearly marked) artifact still emits
+        # backend init would hang/crash this process too; the CPU platform
+        # was forced so a (degraded, clearly marked) artifact still emits
         print(f"# accelerator probe {probe}; falling back to CPU",
               file=sys.stderr)
-        from torchft_tpu.utils import force_virtual_cpu_devices
-
-        force_virtual_cpu_devices(1)
 
     import jax
 

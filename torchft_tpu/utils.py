@@ -49,6 +49,23 @@ def probe_backend(timeout_s: float = 60.0) -> "tuple[str, str]":
     return "crash", f"probe printed no result: {out.stdout[-200:]!r}"
 
 
+def ensure_responsive_backend(timeout_s: float = 240.0) -> "tuple[str, str]":
+    """Probe the default backend; on a hung/crashed init, force the CPU
+    platform so the caller can still run (degraded, but alive).
+
+    The one fallback policy shared by bench.py and __graft_entry__.entry()
+    — a single timeout story, so the bench and the compile check can never
+    classify the same backend differently. Returns ``probe_backend``'s
+    (status, detail); callers surface the degradation in their artifacts.
+    Costs one extra backend init (~tens of seconds on TPU) in the healthy
+    case — the price of never hanging a driver forever.
+    """
+    status, detail = probe_backend(timeout_s)
+    if status in ("hung", "crash"):
+        force_virtual_cpu_devices(1)
+    return status, detail
+
+
 def force_virtual_cpu_devices(n: int) -> None:
     """Force a virtual ``n``-device CPU platform.
 

@@ -1,20 +1,86 @@
-"""Sweep remat policy x batch size for the single-chip Llama bench.
+"""Sweep remat policy x batch size (and splash block size) for the
+single-chip Llama bench.
 
 Finds the config that maximizes MFU on the local chip; bench.py's settings
 should track the winner. Uses bench.py's `timed_train_step` so the sweep
 measures exactly the workload the headline bench reports. Run on TPU
 hardware:
-    python benchmarks/mfu_sweep.py
+    python benchmarks/mfu_sweep.py            # remat x batch x chunk matrix
+    python benchmarks/mfu_sweep.py --blocks   # splash block-size sweep
+
+Every config runs in its OWN SUBPROCESS with a wall-clock timeout: a config
+that wedges the compiler (observed on this toolchain: remat="attn" with the
+splash kernel compiles >25 min and never returns) must cost one timeout, not
+the rest of the matrix. After any timeout the parent re-probes the backend
+and stops the sweep if the platform plugin itself has wedged — launching
+more compiles at a dead tunnel only deepens the wedge.
+
+remat="attn" is additionally skipped on TPU unless TORCHFT_TPU_SWEEP_ATTN=1:
+it is a KNOWN compiler-hang on the current toolchain (models/remat.py), and
+an opt-in flag beats rediscovering that one 20-minute timeout at a time.
 """
 
+import argparse
 import itertools
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import timed_train_step  # noqa: E402
-from torchft_tpu.models.llama import CONFIGS  # noqa: E402
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """\
+import sys
+sys.path.insert(0, {repo!r})
+from bench import timed_train_step
+from torchft_tpu.models.llama import CONFIGS
+from torchft_tpu.ops import attention as _attn
+tps, mfu = timed_train_step(CONFIGS[{cfg!r}], {batch}, {seq}, steps=10,
+                            remat={remat!r}, loss_chunk={chunk})
+print(f"RESULT {{tps:.1f}} {{mfu:.4f}} {{_attn.LAST_DISPATCH}}", flush=True)
+"""
+
+
+def run_config(cfg, batch, seq, remat, chunk, env_extra, timeout_s):
+    """Run one sweep cell in a subprocess; returns a one-line verdict."""
+    env = dict(os.environ, **env_extra)
+    code = _CHILD.format(repo=REPO, cfg=cfg, batch=batch, seq=seq,
+                         remat=remat, chunk=chunk)
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"TIMEOUT >{timeout_s:.0f}s (compiler wedge?)"
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("RESULT "):
+            _, tps, mfu, dispatch = line.split()
+            return (float(tps), float(mfu), dispatch), None
+    tail = (out.stderr.strip() or out.stdout.strip())[-160:]
+    return None, f"FAILED rc={out.returncode}: {tail}"
+
+
+def backend_alive() -> bool:
+    from torchft_tpu.utils import probe_backend
+
+    status, _ = probe_backend(90.0)
+    return status in ("accel", "cpu")
+
+
+def sweep(cells, timeout_s):
+    """cells: iterable of (label, env_extra, kwargs for run_config)."""
+    for label, env_extra, kw in cells:
+        result, err = run_config(env_extra=env_extra, timeout_s=timeout_s, **kw)
+        if result:
+            tps, mfu, dispatch = result
+            print(f"{label}: {tps:10.1f} tok/s  MFU={mfu:.4f}  [{dispatch}]",
+                  flush=True)
+        else:
+            print(f"{label}: {err}", flush=True)
+            if err.startswith("TIMEOUT") and not backend_alive():
+                print("# backend no longer responds after the timeout — "
+                      "stopping the sweep (wedged platform plugin)", flush=True)
+                return
 
 
 def main():
@@ -23,22 +89,40 @@ def main():
     if jax.default_backend() == "cpu":
         sys.exit("mfu_sweep needs a TPU; the bench_350m config would grind "
                  "for hours on CPU (use bench.py, which falls back to tiny).")
-    cfg = CONFIGS["bench_350m"]
-    seq = 2048
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", action="store_true",
+                    help="sweep splash block sizes instead of the remat matrix")
+    ap.add_argument("--timeout", type=float, default=1200.0,
+                    help="per-config wall-clock budget (compile + 10 steps)")
+    args = ap.parse_args()
+
+    cfg, seq = "bench_350m", 2048
     attn = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
-    for remat_mode, batch, chunk in itertools.product(
-        ["dots", "none", "full", "attn"], [8, 16, 32], [0, 512]
-    ):
-        try:
-            tps, mfu = timed_train_step(cfg, batch, seq, steps=10,
-                                        remat=remat_mode, loss_chunk=chunk)
-            print(f"attn={attn} remat={remat_mode:5s} batch={batch:3d} "
-                  f"chunk={chunk:4d}: {tps:10.1f} tok/s  MFU={mfu:.4f}",
-                  flush=True)
-        except Exception as e:
-            print(f"attn={attn} remat={remat_mode:5s} batch={batch:3d} "
-                  f"chunk={chunk:4d}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+
+    if args.blocks:
+        cells = [
+            (f"attn=splash block={blk:4d} remat=full batch=8",
+             {"TORCHFT_TPU_ATTENTION": "splash",
+              "TORCHFT_TPU_SPLASH_BLOCK": str(blk)},
+             dict(cfg=cfg, batch=8, seq=seq, remat="full", chunk=0))
+            for blk in (128, 256, 512, 1024, 2048)
+        ]
+        sweep(cells, args.timeout)
+        return
+
+    remats = ["dots", "none", "full", "attn"]
+    if os.environ.get("TORCHFT_TPU_SWEEP_ATTN") != "1":
+        remats.remove("attn")
+        print("# remat='attn' skipped: known compiler hang on this toolchain "
+              "(set TORCHFT_TPU_SWEEP_ATTN=1 to retry)", flush=True)
+    cells = [
+        (f"attn={attn} remat={remat:5s} batch={batch:3d} chunk={chunk:4d}",
+         {},
+         dict(cfg=cfg, batch=batch, seq=seq, remat=remat, chunk=chunk))
+        for remat, batch, chunk in itertools.product(remats, [8, 16, 32], [0, 512])
+    ]
+    sweep(cells, args.timeout)
 
 
 if __name__ == "__main__":

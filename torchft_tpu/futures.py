@@ -47,26 +47,44 @@ def _to_seconds(timeout: "float | timedelta") -> float:
     return float(timeout)
 
 
-class _TimerHandle:
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._handle: Optional[asyncio.TimerHandle] = None
-        self._cancelled = False
+def _arm_on_loop(
+    loop: asyncio.AbstractEventLoop, delay: float, fn: Callable[[], None]
+) -> Callable[[], None]:
+    """Schedule ``fn`` to run after ``delay`` on ``loop``; return a
+    thread-safe cancel function.
 
-    def set_timer_handle(self, handle: asyncio.TimerHandle) -> None:
-        with self._lock:
-            if self._cancelled:
-                handle.cancel()
-                self._handle = None
-            else:
-                self._handle = handle
+    Lock-free by construction: the ``call_later`` handle is only ever touched
+    on the loop thread. A cancel that lands before the install step has run
+    flips ``dead`` (visible to the install closure, which then never creates
+    the timer); a cancel that lands after it enqueues the handle-cancel behind
+    the install on the loop's FIFO queue. A cancel racing the timer firing is
+    inherently unresolvable here — callers' timeout callbacks must tolerate
+    it (they all guard on ``out.done()``).
+    """
+    slot: "list[Optional[asyncio.TimerHandle]]" = [None]
+    dead = False
 
-    def cancel(self) -> None:
-        with self._lock:
-            self._cancelled = True
-            if self._handle is not None:
-                self._handle.cancel()
-                self._handle = None
+    def _install() -> None:
+        if not dead:
+            slot[0] = loop.call_later(delay, fn)
+
+    loop.call_soon_threadsafe(_install)
+
+    def _cancel() -> None:
+        nonlocal dead
+        dead = True
+
+        def _revoke() -> None:
+            if slot[0] is not None:
+                slot[0].cancel()
+                slot[0] = None
+
+        try:
+            loop.call_soon_threadsafe(_revoke)
+        except RuntimeError:
+            pass  # loop already shut down; nothing left to fire
+
+    return _cancel
 
 
 class _TimeoutManager:
@@ -140,7 +158,6 @@ class _TimeoutManager:
     def register(self, fut: Future[T], timeout: float) -> Future[T]:
         loop = self._maybe_start()
         out: Future[T] = Future()
-        handle = _TimerHandle()
 
         def _on_timeout() -> None:
             if not out.done():
@@ -151,12 +168,10 @@ class _TimeoutManager:
                 except RuntimeError:
                     pass
 
-        loop.call_soon_threadsafe(
-            lambda: handle.set_timer_handle(loop.call_later(timeout, _on_timeout))
-        )
+        cancel_timer = _arm_on_loop(loop, timeout, _on_timeout)
 
         def _transfer(f: Future[T]) -> None:
-            handle.cancel()
+            cancel_timer()
             if out.done():
                 return
             try:
@@ -172,12 +187,7 @@ class _TimeoutManager:
         return out
 
     def arm(self, callback: Callable[[], None], timeout: float) -> Callable[[], None]:
-        loop = self._maybe_start()
-        handle = _TimerHandle()
-        loop.call_soon_threadsafe(
-            lambda: handle.set_timer_handle(loop.call_later(timeout, callback))
-        )
-        return handle.cancel
+        return _arm_on_loop(self._maybe_start(), timeout, callback)
 
     def context_timeout(
         self, callback: Callable[[], None], timeout: float

@@ -787,10 +787,14 @@ class Manager:
         # count error EPISODES, not report_error calls: one wire fault fans
         # out into a report per in-flight allreduce plus one per commit vote
         # while the PG stays errored — operators comparing this against
-        # commit_failures need fault frequency, not callback fan-out
-        if self._errored is None:
-            self._bump_metric("errors")
-        self._errored = ExceptionWithTraceback(e)
+        # commit_failures need fault frequency, not callback fan-out. The
+        # None-check and the assignment must be one atomic step: reports
+        # arrive concurrently from allreduce done-callbacks and the timeout
+        # loop, and two threads both observing None would double-count.
+        with self._metrics_lock:
+            if self._errored is None:
+                self._metrics["errors"] += 1
+            self._errored = ExceptionWithTraceback(e)
         from torchft_tpu.flight_recorder import recorder
 
         recorder.record(

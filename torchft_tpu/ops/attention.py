@@ -159,6 +159,15 @@ def splash_attention_tpu(
     vt = jnp.swapaxes(v, 1, 2)
     S = qt.shape[2]
     blk = next(b for b in (512, 256, 128) if S % b == 0)
+    # benchmark escape hatch: benchmarks/mfu_sweep.py sweeps this to find the
+    # best tile for a given chip generation; training code leaves it unset
+    blk_env = os.environ.get("TORCHFT_TPU_SPLASH_BLOCK")
+    if blk_env:
+        blk = int(blk_env)
+        if S % blk != 0:
+            raise ValueError(
+                f"TORCHFT_TPU_SPLASH_BLOCK={blk} does not divide seq_len {S}"
+            )
     kernel = _splash_kernel(qt.shape[1], S, blk, interpret)
     out = jax.vmap(kernel)(qt, kt, vt)  # [B, Hq, S, hd]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)

@@ -72,30 +72,76 @@ def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
     return tokens_per_sec, mfu
 
 
-def fault_tolerance_metrics(size_mb: int = 8, steps: int = 12, kill_at: int = 4):
+def fault_tolerance_metrics(size_mb: int = 8, steps: int = 12, kill_at: int = 4,
+                            plane: str = "host"):
     """Fault tolerance in the measured loop (the BASELINE.md north-star):
     two replica groups through a real lighthouse + Managers + the host
     data plane, one replica killed mid-run. Returns steady per-step FT
-    overhead and the recovery wall-clock (VERDICT round-2 item 4)."""
+    overhead and the recovery wall-clock (VERDICT round-2 item 4).
+
+    Runs in a SUBPROCESS pinned to the CPU platform: the FT scenario never
+    needs the accelerator, and keeping it out of this process means the
+    TPU bench above stays the only accelerator work in the driver's process
+    tree (round 3's artifact died because non-bench work wedged the tunnel
+    first — VERDICT round-3 item 1).
+    """
+    import json as _json
     import os
+    import subprocess
     import sys
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "benchmarks"))
-    from recovery_bench import run as recovery_run
-
-    r = recovery_run(size_mb=size_mb, steps=steps, kill_at=kill_at)
-    return {
-        "ft_steady_step_s": r["steady_step_s"],
-        "ft_recovery_s": r["reconfigure_s"],
-        "ft_rejoin_s": r["rejoin_s"],
-        "ft_payload_mb": r["size_mb"],
-    }
+    child = (
+        "from torchft_tpu.utils import force_virtual_cpu_devices\n"
+        f"force_virtual_cpu_devices({2 if plane == 'device' else 1})\n"
+        "import sys, json\n"
+        f"sys.path.insert(0, {os.path.join(os.path.dirname(os.path.abspath(__file__)), 'benchmarks')!r})\n"
+        "from recovery_bench import run\n"
+        f"print('FTRESULT ' + json.dumps(run(size_mb={size_mb}, steps={steps}, "
+        f"kill_at={kill_at}, plane={plane!r}, collective_timeout=3.0)))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=420, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("FTRESULT "):
+            r = _json.loads(line[len("FTRESULT "):])
+            prefix = "ft_device_" if plane == "device" else "ft_"
+            return {
+                f"{prefix}steady_step_s": r["steady_step_s"],
+                f"{prefix}recovery_s": r["recovery_s"],
+                f"{prefix}rejoin_s": r["rejoin_s"],
+                f"{prefix}payload_mb": r["size_mb"],
+                **(
+                    {
+                        f"{prefix}detection_quorum_s": r["detection_quorum_s"],
+                        f"{prefix}pg_configure_s": r["pg_configure_s"],
+                        f"{prefix}heal_recv_s": r["heal_recv_s"],
+                    }
+                    if plane == "device"
+                    else {}
+                ),
+            }
+    raise RuntimeError(
+        f"recovery bench child failed rc={out.returncode}: "
+        f"{(out.stderr or out.stdout)[-300:]}"
+    )
 
 
 def main() -> None:
     # shared fallback policy (ensure_responsive_backend): one probe, one
     # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
-    from torchft_tpu.utils import ensure_responsive_backend
+    from torchft_tpu.utils import (
+        enable_compilation_cache,
+        ensure_responsive_backend,
+    )
+
+    # persistent compilation cache BEFORE any compile: the bench's heavy
+    # compile happens once per toolchain, and the driver's artifact run
+    # replays the cached executable (compiles are the known tunnel-wedge
+    # trigger on this image — docs/operations.md)
+    enable_compilation_cache()
 
     probe, probe_detail = ensure_responsive_backend()
     if probe == "crash":
@@ -125,46 +171,38 @@ def main() -> None:
     # a zero. Dispatch honors TORCHFT_TPU_ATTENTION (ops/attention.py).
     import os
 
-    # On TPU, RACE the two fused kernels and keep the faster: splash (GQA-
-    # native) should win on this GQA config but is newer; flash is the
-    # measured baseline. A kernel that fails just drops out of the race;
-    # xla remains the backstop so a Pallas regression degrades the number
-    # instead of zeroing the round.
+    # splash is the measured winner on this GQA config (0.451 vs 0.434 MFU
+    # for flash, round-3 sweep — docs/performance.md); the bench PINS it and
+    # only falls back (flash, then xla) if it fails. Round 3 raced splash vs
+    # flash each run; with the persistent compilation cache the race's
+    # discovery value is gone and its cost (a second compile+run against a
+    # wedge-prone tunnel) is not worth paying in the driver's one artifact
+    # run. benchmarks/mfu_sweep.py is where kernels compete now.
     pinned = os.environ.get("TORCHFT_TPU_ATTENTION")
-    # the race only makes sense where the Pallas kernels can actually run;
-    # on any other backend both legs would dispatch to the same XLA path
-    # (causal_attention falls back off-TPU) and just double the wall time
-    race = backend == "tpu"
-    attention_modes = (
-        [pinned] if pinned else (["splash", "flash"] if race else ["auto"])
-    )
+    if pinned:
+        attention_modes = [pinned]  # explicit pin fails LOUDLY (no backstop)
+    elif backend == "tpu":
+        attention_modes = ["splash", "flash", "xla"]
+    else:
+        attention_modes = ["auto"]
     from torchft_tpu.ops import attention as _attn
 
     first_err = None
-    results = []  # (tokens_per_sec, mfu, "requested:resolved")
+    result = None  # (tokens_per_sec, mfu, "requested:resolved")
     for mode in attention_modes:
         os.environ["TORCHFT_TPU_ATTENTION"] = mode
         try:
             tps_m, mfu_m = timed_train_step(cfg, batch, seq, steps)
-            results.append((tps_m, mfu_m, f"{mode}:{_attn.LAST_DISPATCH}"))
+            result = (tps_m, mfu_m, f"{mode}:{_attn.LAST_DISPATCH}")
+            break
         except Exception as e:  # noqa: BLE001
             # the first failure is the root cause (later modes usually fail
             # identically for non-attention errors)
             first_err = first_err or e
             print(f"# attention mode {mode!r} failed: {e}", file=sys.stderr)
-    if not results and not pinned:
-        # backstop only for the default race: an explicitly pinned kernel
-        # failing must fail LOUDLY (a CI gate pinning splash should see the
-        # regression, not a healthy-looking xla number)
-        os.environ["TORCHFT_TPU_ATTENTION"] = "xla"
-        try:
-            tps_m, mfu_m = timed_train_step(cfg, batch, seq, steps)
-            results.append((tps_m, mfu_m, f"xla:{_attn.LAST_DISPATCH}"))
-        except Exception:  # noqa: BLE001
-            raise first_err
-    if not results:
+    if result is None:
         raise first_err
-    tokens_per_sec, mfu, mode = max(results)
+    tokens_per_sec, mfu, mode = result
     n_params = cfg.num_params()
 
     record = {
@@ -187,11 +225,20 @@ def main() -> None:
         record["error"] = f"accelerator {detail}; CPU fallback"
 
     # FT metrics ride the same line; a failure here must never cost the
-    # headline number.
+    # headline number. Host plane at the legacy 8 MB payload (comparable to
+    # round<=3 artifacts), device plane at 256 MB (VERDICT round-3 item 4:
+    # recovery cost where the collective payload is ProcessGroupXLA's).
     try:
         record.update(fault_tolerance_metrics())
     except Exception as e:  # noqa: BLE001
         record["ft_error"] = str(e)[:200]
+    try:
+        record.update(
+            fault_tolerance_metrics(size_mb=256, steps=10, kill_at=3,
+                                    plane="device")
+        )
+    except Exception as e:  # noqa: BLE001
+        record["ft_device_error"] = str(e)[:200]
 
     print(json.dumps(record))
 

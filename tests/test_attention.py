@@ -145,6 +145,7 @@ class TestSplashAttention:
         assert np.isfinite(np.asarray(g)).all()
 
 
+@pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
 class TestSplashInModel:
     def test_llama_fwd_bwd_matches_xla(self):
         """End-to-end: the GQA llama layer stack through the splash kernel

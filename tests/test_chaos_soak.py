@@ -28,6 +28,8 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # randomized multi-replica soak
+
 from torchft_tpu.coordination import LighthouseServer
 from torchft_tpu.manager import Manager
 from torchft_tpu.process_group import ProcessGroupHost

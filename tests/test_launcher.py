@@ -9,6 +9,8 @@ import urllib.request
 
 import pytest
 
+pytestmark = pytest.mark.slow  # subprocess replica fleets + CLI round-trips
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from torchft_tpu.launcher import (

@@ -265,6 +265,7 @@ class TestStreamingDiLoCoScenarios:
         nominal = -0.3 * self.OUTER_TARGET
         assert nominal - 0.3 <= float(w0[0]) <= nominal + 0.3, w0
 
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_crash_mid_fragment_cycle_streaming(self):
         """Streaming DiLoCo (2 fragments, staggered syncs): replica 1 dies
         between the two fragments' sync points, rejoins, heals, and both

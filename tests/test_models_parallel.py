@@ -56,6 +56,7 @@ class TestLlama:
         assert all(l > 0 for l in leaves), "some parameter got zero gradient"
 
     @pytest.mark.parametrize("chunk", [4, 8, 16])
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_chunked_loss_matches_full(self, params, chunk):
         """loss_chunk changes HBM residency, never the math: value and
         gradients must equal the full-logits path."""
@@ -78,6 +79,7 @@ class TestLlama:
             )
 
     @pytest.mark.parametrize("mode", ["dots", "attn", "full"])
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_remat_modes_change_nothing_but_memory(self, params, mode):
         """Every remat mode is a pure recompute schedule: loss and gradients
         must match the no-remat path bit-for-near-bit."""

@@ -67,6 +67,7 @@ class TestMoEFFN:
         dense = (jax.nn.silu(x @ wg[0]) * (x @ wu[0])) @ wd[0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_forward_and_grads_finite(self):
         cfg = MOE_CONFIGS["debug"]
         params = moe_init(jax.random.PRNGKey(0), cfg)
@@ -83,6 +84,7 @@ class TestMoEFFN:
 
 
 class TestExpertParallel:
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_ep_sharded_train_step(self):
         """Full MoE train step jitted over a mesh with a real ep axis."""
         import optax

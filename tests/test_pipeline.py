@@ -73,6 +73,7 @@ class TestPPLlama:
             got = float(jax.jit(pp_loss)(params, toks, toks))
         assert abs(got - dense) < 1e-4, (got, dense)
 
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_train_step_with_sharded_layers(self):
         """Full jitted pp train step: layers sharded over pp, loss decreases."""
         import optax

@@ -193,6 +193,7 @@ class TestQuantizedCollectives:
             allreduce_quantized([np.ones(4)], ReduceOp.MAX, pgs[0])
         pgs[0].shutdown()
 
+    @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
     def test_manager_allreduce_quantized_path(self, store):
         """should_quantize=True end-to-end through the Manager."""
         from unittest.mock import MagicMock, patch

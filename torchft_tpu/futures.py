@@ -54,19 +54,24 @@ def _arm_on_loop(
     thread-safe cancel function.
 
     Lock-free by construction: the ``call_later`` handle is only ever touched
-    on the loop thread. A cancel that lands before the install step has run
-    flips ``dead`` (visible to the install closure, which then never creates
-    the timer); a cancel that lands after it enqueues the handle-cancel behind
-    the install on the loop's FIFO queue. A cancel racing the timer firing is
-    inherently unresolvable here — callers' timeout callbacks must tolerate
-    it (they all guard on ``out.done()``).
+    on the loop thread. The ``dead`` flag is the synchronous kill switch —
+    ``_cancel`` flips it on the caller's thread (a GIL-atomic store), and the
+    fire wrapper re-checks it at invocation time, so once ``_cancel`` returns
+    a not-yet-started ``fn`` can no longer run even if the loop is backed up
+    and processes the deadline before the revoke. The only residual race is
+    ``fn`` already mid-execution at cancel time, which no timer design can
+    close from outside.
     """
     slot: "list[Optional[asyncio.TimerHandle]]" = [None]
     dead = False
 
+    def _fire() -> None:
+        if not dead:
+            fn()
+
     def _install() -> None:
         if not dead:
-            slot[0] = loop.call_later(delay, fn)
+            slot[0] = loop.call_later(delay, _fire)
 
     loop.call_soon_threadsafe(_install)
 

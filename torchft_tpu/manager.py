@@ -961,6 +961,13 @@ class Manager:
                 if key in user_state:
                     load_fn(user_state[key])
 
+    def current_quorum_id(self) -> int:
+        """The id of the last quorum this manager joined (-1 before the
+        first). Bumps exactly when the lighthouse changes membership (or
+        after commit failures) — operators and benchmarks use the bump as
+        the observable 'membership changed' edge."""
+        return self._quorum_id
+
     def current_step(self) -> int:
         return self._step
 

@@ -79,6 +79,88 @@ _REDUCERS = {
     ReduceOp.PRODUCT: lambda a: a.prod(axis=0),
 }
 
+# Peer-failure detection latency for the per-quorum jax.distributed world.
+# jax.distributed.initialize's default is 100s — useless for per-step fault
+# tolerance; the reference's NCCL plane detects via op timeout in seconds.
+_HEARTBEAT_TIMEOUT_S = float(os.environ.get("TORCHFT_XLA_HEARTBEAT_SEC", 10.0))
+
+
+def _join_distributed_world(
+    coord: str,
+    rank: int,
+    world_size: int,
+    timeout: float,
+) -> None:
+    """Join a per-quorum ``jax.distributed`` world with FT-grade options.
+
+    Vanilla ``jax.distributed.initialize`` is unusable as a reconfigurable
+    communicator on this toolchain (jax 0.9.0, measured in
+    docs/operations.md):
+
+    - ``shutdown()`` on a degraded world blocks in the cooperative shutdown
+      barrier and then ``LOG(FATAL)``s the process;
+    - the default 100s heartbeat hides peer death from the quorum layer;
+    - overriding ``missed_heartbeat_callback`` is not viable: jaxlib's
+      binding cannot convert the ``absl::Status`` argument (``std::bad_cast``
+      → ``std::terminate``).
+
+    Nor can a degraded world be abandoned silently: a released client's
+    heartbeat/error-poll threads hold it alive internally, and the
+    coordination service pushes a task-death error to every live poller
+    ~heartbeat_timeout after a peer dies (measured: 11.0s at the 10s
+    default; ``recoverable=True`` merely stretches it to ~25s). The
+    consequence is a hard toolchain invariant this module is designed
+    around (docs/operations.md): **membership can only shrink by process
+    restart** — a member of a degraded distributed world always dies; the
+    short heartbeat bounds *when*, and the supervising launcher restarting
+    it into the next quorum is the recovery path (the reference's
+    Baby-subprocess isolation inverted: the trainer process is the
+    expendable child, the launcher is the parent). Healthy transitions
+    (same membership re-keyed, grows, graceful leaves) reconfigure
+    IN-PROCESS via the cooperative shutdown barrier, which succeeds
+    precisely when everyone is alive to vote.
+
+    The same ``jax._src.distributed.global_state`` fields are populated as
+    ``initialize`` would, so backend creation picks up the world normally.
+    """
+    import jax
+    from jax._src import distributed as _dist
+    from jax._src.lib import _jax as _jaxlib
+
+    state = _dist.global_state
+    if state.client is not None:
+        raise RuntimeError(
+            "a jax.distributed world is already initialized; tear it down "
+            "before joining a new quorum"
+        )
+
+    hb = max(1, int(_HEARTBEAT_TIMEOUT_S))
+    # the cooperative-shutdown barrier wait: short, because on a degraded
+    # world the barrier CANNOT succeed and its failure is process-fatal —
+    # a small bound turns "die eventually" into "die promptly, restart"
+    shutdown_to = min(max(1, int(timeout)), 10)
+    if rank == 0:
+        bind = "[::]:" + coord.rsplit(":", 1)[1]
+        state.service = _jaxlib.get_distributed_runtime_service(
+            bind, world_size, heartbeat_timeout=hb,
+            shutdown_timeout=shutdown_to,
+        )
+
+    client = _jaxlib.get_distributed_runtime_client(
+        coord, rank,
+        init_timeout=max(1, int(timeout)),
+        heartbeat_timeout=hb,
+        shutdown_timeout=shutdown_to,
+        shutdown_on_destruction=False,
+        use_compression=True,
+    )
+    logger.info("joining distributed world %s as %d/%d", coord, rank, world_size)
+    client.connect()
+    state.client = client
+    state.process_id = rank
+    state.num_processes = world_size
+    state.coordinator_address = coord
+
 
 def _lead_devices_local(world: int) -> List[Any]:
     """One lead device per replica from the local device pool."""
@@ -481,7 +563,7 @@ class ProcessGroupXLA(ProcessGroup):
         else:
             coord = kv.get(f"{prefix}/xla_coordinator", timeout=self._timeout).decode()
 
-        jax.distributed.initialize(coord, num_processes=world_size, process_id=rank)
+        _join_distributed_world(coord, rank, world_size, self._timeout)
 
         devices = jax.devices()
         leads = []
@@ -491,15 +573,35 @@ class ProcessGroupXLA(ProcessGroup):
                 raise RuntimeError(f"no devices visible for process {p}")
             leads.append(min(pd, key=lambda d: d.id))
         mesh = Mesh(np.array(leads), ("replica",))
-        return _XlaWorld(mesh, leads, world_size, distributed=True, quorum_id=quorum_id)
+        return _XlaWorld(
+            mesh, leads, world_size, distributed=True, quorum_id=quorum_id
+        )
 
     def _teardown_distributed_world(self) -> None:
-        import jax
+        """Leave the per-quorum world.
 
-        try:
-            jax.distributed.shutdown()
-        except Exception as e:  # noqa: BLE001 - already down is fine
-            logger.debug("jax.distributed.shutdown: %s", e)
+        1. ``clear_backends`` first — the backend holds a reference to the
+           runtime client; the client cannot be released while a backend
+           could still issue RPCs through it.
+        2. Cooperative ``client.shutdown()`` on a bounded daemon thread. On
+           a HEALTHY transition (same members re-keyed, grow, graceful
+           leave) the shutdown barrier completes in milliseconds, the
+           client's heartbeat/error-poll threads stop, and the teardown is
+           clean. On a DEGRADED world the barrier cannot complete and its
+           failure (or the coordinator's task-death error push, whichever
+           lands first) is process-fatal by toolchain design — the short
+           ``shutdown_timeout``/heartbeat bounds make that death prompt,
+           and the supervising launcher restarting this process into the
+           next quorum is the recovery path (see _join_distributed_world's
+           docstring and docs/operations.md). Merely dropping the reference
+           is NOT an escape hatch: the client's own threads keep it alive
+           and polling, and the poll fatals within a heartbeat window
+           anyway.
+        3. Rank 0 shuts the coordination service down after the barrier.
+        """
+        import jax
+        from jax._src import distributed as _dist
+
         jax.clear_caches()
         try:
             import jax.extend
@@ -507,6 +609,31 @@ class ProcessGroupXLA(ProcessGroup):
             jax.extend.backend.clear_backends()
         except Exception as e:  # noqa: BLE001
             logger.warning("clear_backends failed: %s", e)
+
+        state = _dist.global_state
+        client, state.client = state.client, None
+        service, state.service = state.service, None
+        state.process_id = 0
+        state.num_processes = None
+        state.coordinator_address = None
+
+        if client is not None:
+            t = threading.Thread(
+                target=lambda: client.shutdown(),
+                daemon=True,
+                name="pgxla_client_shutdown",
+            )
+            t.start()
+            t.join(12.0)
+        del client
+        if service is not None:
+            t = threading.Thread(
+                target=lambda: service.shutdown(),
+                daemon=True,
+                name="pgxla_service_shutdown",
+            )
+            t.start()
+            t.join(5.0)
 
     def abort(self) -> None:
         err = RuntimeError("process group aborted")

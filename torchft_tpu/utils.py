@@ -93,6 +93,34 @@ def force_virtual_cpu_devices(n: int) -> None:
         pass  # backend already initialised; caller's device check reports it
 
 
+def enable_compilation_cache(cache_dir: "str | None" = None) -> str:
+    """Turn on JAX's persistent compilation cache rooted at ``cache_dir``.
+
+    Heavy compiles are the one operation that has wedged this image's
+    tunnelled TPU backend (see docs/operations.md); with a persistent cache
+    they happen once per toolchain instead of once per process, so the
+    driver's bench run replays cached executables instead of re-risking the
+    compile. Sets the env var too so child processes (sweep subprocesses,
+    probe children) share the cache. Returns the directory used.
+    """
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                         ".jax_cache"),
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache everything: the point is never recompiling, not saving disk
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 def np_dtype_from_str(name: str):
     """np.dtype for a dtype name, including ml_dtypes extended types
     (bfloat16, float8_*) that plain np.dtype() doesn't know."""

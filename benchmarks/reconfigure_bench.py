@@ -6,9 +6,10 @@ The reference's whole design is a per-quorum communicator rebuild
 times the TPU-native equivalents for every path a quorum change can take:
 
 - **local**: ``ProcessGroupXLA(mode="local").configure`` — new mesh over
-  surviving lead devices + fresh jit cache. Measured: first configure,
-  shrink reconfigure (new quorum id), and the same-quorum no-op re-enter
-  (hits the process-global world registry).
+  surviving lead devices + fresh jit cache. Measured: first configure
+  (fresh world build), the same-quorum re-enter (a second replica's
+  configure hitting the process-global world registry), and the shrink
+  reconfigure (new quorum id, fresh build).
 - **distributed**: a real ``jax.distributed`` world per quorum, one process
   per replica (spawned fabric, one CPU device each — the same mechanism the
   spawned-process tests use). Measured per rank: initial world init, and
@@ -28,9 +29,11 @@ measurements so the driver's MULTICHIP artifact records them.
 
 import json
 import os
+import queue
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -85,8 +88,16 @@ def measure_local() -> dict:
         t0 = time.perf_counter()
         pg.configure(addr, 0, 2, quorum_id=1)
         first_ms = (time.perf_counter() - t0) * 1e3
+
+        # same-quorum re-enter: the SECOND replica configuring into the
+        # key the first replica's configure just built — the actual
+        # registry-hit path (re-configuring the same PG instance would
+        # poison its own world on teardown and measure a fresh rebuild)
         pg2 = ProcessGroupXLA(timeout=30.0, mode="local")
+        t0 = time.perf_counter()
         pg2.configure(addr, 1, 2, quorum_id=1)
+        reenter_ms = (time.perf_counter() - t0) * 1e3
+
         # a collective forces the jit path to materialize once
         w0 = pg.allreduce([jnp.ones(4)], ReduceOp.SUM)
         w1 = pg2.allreduce([jnp.ones(4)], ReduceOp.SUM)
@@ -96,11 +107,6 @@ def measure_local() -> dict:
         t0 = time.perf_counter()
         pg.configure(addr, 0, 1, quorum_id=2)
         shrink_ms = (time.perf_counter() - t0) * 1e3
-
-        # same-quorum re-enter (another replica joining the registry entry)
-        t0 = time.perf_counter()
-        pg.configure(addr, 0, 1, quorum_id=2)
-        reenter_ms = (time.perf_counter() - t0) * 1e3
         pg.shutdown()
         pg2.shutdown()
     finally:
@@ -267,26 +273,52 @@ def measure_restart_mttr(timeout: float = 300.0) -> dict:
     script = _RESTART_WORKER.format(repo=REPO)
 
     def spawn(role):
-        return subprocess.Popen(
+        p = subprocess.Popen(
             [sys.executable, "-c", script, role, str(store.port)],
             stdout=subprocess.PIPE, stdin=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True, env=env, bufsize=1,
         )
+        # lines arrive via a reader thread + queue so await_line's budget
+        # bounds the WAIT, not just the line count — a worker that wedges
+        # alive-but-silent (stuck runtime thread) must cost one timeout,
+        # not hang the bench on a blocking readline
+        p.lines = queue.Queue()
+        def _pump(pipe, q):
+            for line in pipe:
+                q.put(line)
+            q.put(None)  # EOF
+        threading.Thread(
+            target=_pump, args=(p.stdout, p.lines), daemon=True,
+            name=f"reconfigure_bench_{role}_reader",
+        ).start()
+        return p
 
     def await_line(p, want, budget=timeout):
         t_end = time.monotonic() + budget
-        while time.monotonic() < t_end:
-            line = p.stdout.readline()
-            if not line:
+        while True:
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"no {want!r} within {budget}s")
+            try:
+                line = p.lines.get(timeout=remaining)
+            except queue.Empty:
+                raise TimeoutError(f"no {want!r} within {budget}s") from None
+            if line is None:
                 raise RuntimeError(
                     f"worker exited (rc={p.poll()}) waiting for {want!r}"
                 )
             if line.startswith(want):
                 return line
-        raise TimeoutError(f"no {want!r} within {budget}s")
 
-    m0 = spawn("member0")
-    m1 = spawn("member1")
+    workers = []
+
+    def spawn_tracked(role):
+        p = spawn(role)
+        workers.append(p)
+        return p
+
+    m0 = spawn_tracked("member0")
+    m1 = spawn_tracked("member1")
     try:
         await_line(m0, "PHASE steady")
         await_line(m1, "PHASE steady")
@@ -301,8 +333,8 @@ def measure_restart_mttr(timeout: float = 300.0) -> dict:
         fatal_detect_ms = (time.perf_counter() - t_kill) * 1e3
 
         t_respawn = time.perf_counter()
-        f0 = spawn("fresh0")
-        f1 = spawn("fresh1")
+        f0 = spawn_tracked("fresh0")
+        f1 = spawn_tracked("fresh1")
         joins = {}
         for p in (f0, f1):
             line = await_line(p, "TIMING ")
@@ -312,7 +344,9 @@ def measure_restart_mttr(timeout: float = 300.0) -> dict:
         f0.wait(30)
         f1.wait(30)
     finally:
-        for p in (m0, m1):
+        # every spawned generation: a TIMING wait that times out must not
+        # orphan the fresh workers (live jax.distributed world) either
+        for p in workers:
             if p.poll() is None:
                 p.kill()
         store.shutdown()

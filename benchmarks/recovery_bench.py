@@ -19,14 +19,16 @@ Measured, in seconds (every component separately — VERDICT round-3 item 4):
 - **detection_quorum_s**: kill -> survivor's first quorum with a bumped
   quorum_id (includes the discarded-step timeout on the device plane,
   heartbeat expiry, and the quorum RPC).
-- **reconfigure_s**: the survivor's timed ``pg.configure`` call for that
+- **pg_configure_s**: the survivor's timed ``pg.configure`` call for that
   quorum (communicator rebuild only).
-- **reconfigure_s** (rejoiner's heal): **heal_recv_s** — the restarted
-  replica's ``recv_checkpoint`` wall-clock (checkpoint transfer only).
+- **heal_recv_s**: the restarted replica's ``recv_checkpoint`` wall-clock
+  (checkpoint transfer only).
 - **recovery_s**: kill -> survivor's first committed step past the kill
-  step (the end-to-end number; named ``reconfigure_s`` in round<=3
-  artifacts).
+  step (the end-to-end number).
 - **rejoin_s**: restarted replica's Manager construction -> first commit.
+- **reconfigure_s**: legacy alias of ``recovery_s`` kept so round<=3
+  artifacts stay comparable — NOT the communicator rebuild, which is
+  ``pg_configure_s``.
 
     python benchmarks/recovery_bench.py [--plane device] [--size-mb 256]
 

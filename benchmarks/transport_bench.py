@@ -56,6 +56,25 @@ def make_state(size_mb: int, chunk_mb: int = 64) -> dict:
     }
 
 
+def make_template(size_mb: int, chunk_mb: int = 64) -> dict:
+    """Same tree shape as ``make_state`` but zero-filled without the RNG —
+    the in-place receiver must not inflate its RSS baseline (or its startup
+    time) with a full random regeneration before the measurement.
+
+    ``np.full`` rather than ``np.zeros``: zeros is calloc-lazy, so the
+    template's pages would only become resident when the in-place copy
+    writes them — charging the template's own footprint to the receive
+    phase. A real trainer's live state is resident; make the template so.
+    """
+    n_chunks = max(1, size_mb // chunk_mb)
+    per = size_mb * (1 << 20) // n_chunks // 4
+    return {
+        "user": {
+            f"layer_{i}": np.full(per, 0, np.float32) for i in range(n_chunks)
+        }
+    }
+
+
 def bench_http(state: dict, num_chunks: int, timeout: float) -> float:
     from torchft_tpu.checkpointing import HTTPTransport
 
@@ -121,7 +140,7 @@ def bench_pg(state: dict, inplace: bool, timeout: float) -> float:
         store.shutdown()
 
 
-def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool) -> None:
+def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool) -> dict:
     """Per-side RSS for the PG transport: parent = rank 0 sender, child =
     rank 1 receiver, each its own process over a shared KV store. With
     ``inplace`` the child preallocates a template and receives into it."""
@@ -169,7 +188,7 @@ def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool) -> None:
         sender.shutdown()
         pg.shutdown()
         store.shutdown()
-    print(json.dumps({
+    stats = {
         "transport": "pg-2proc",
         "size_mb": size_mb,
         "inplace": inplace,
@@ -179,7 +198,9 @@ def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool) -> None:
         "receiver_rss_x_payload": round(
             recv_stats["rss_delta_mb"] / payload_mb, 2
         ),
-    }), flush=True)
+    }
+    print(json.dumps(stats), flush=True)
+    return stats
 
 
 def _verify_and_report_recv(got: dict, dt: float, delta: float) -> None:
@@ -196,10 +217,7 @@ def _pg_recv_child(addr: str, size_mb: int, timeout: float, inplace: bool) -> No
     from torchft_tpu.checkpointing import PGTransport
     from torchft_tpu.process_group import ProcessGroupHost
 
-    template = (
-        {"user": {k: np.zeros_like(v) for k, v in make_state(size_mb).items()}}
-        if inplace else None
-    )
+    template = make_template(size_mb) if inplace else None
     pg = ProcessGroupHost(timeout=timeout)
     recv = PGTransport(
         pg, timeout=timeout,
@@ -220,7 +238,7 @@ def _pg_recv_child(addr: str, size_mb: int, timeout: float, inplace: bool) -> No
     _verify_and_report_recv(got, dt, delta)
 
 
-def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> None:
+def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> dict:
     """Per-SIDE peak RSS (the streaming bound is ~1x payload + one leaf per
     side; the single-process bench necessarily shows ~2x because both ends
     share one address space). Parent stages + serves; a fresh child fetches
@@ -259,7 +277,7 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> Non
         recv_stats = json.loads(child.stdout.strip().splitlines()[-1])
     finally:
         send.shutdown()
-    print(json.dumps({
+    stats = {
         "transport": "http-2proc",
         "size_mb": size_mb,
         "seconds": recv_stats["seconds"],
@@ -268,7 +286,9 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> Non
         "receiver_rss_x_payload": round(
             recv_stats["rss_delta_mb"] / payload_mb, 2
         ),
-    }), flush=True)
+    }
+    print(json.dumps(stats), flush=True)
+    return stats
 
 
 def _recv_child(metadata: str, size_mb: int, num_chunks: int, timeout: float) -> None:
@@ -373,9 +393,21 @@ def main() -> None:
     parser.add_argument("--two-process", action="store_true",
                         help="http/pg: sender and receiver in separate "
                              "processes, per-side peak RSS")
+    parser.add_argument("--check", action="store_true",
+                        help="two-process: exit 1 if a side's peak RSS "
+                             "exceeds --rss-bound x payload (regression "
+                             "guard for the streaming paths)")
+    parser.add_argument("--rss-bound", type=float, default=1.15,
+                        help="per-side peak-RSS/payload ceiling for --check "
+                             "(streaming bound is ~1x + one leaf)")
     parser.add_argument("--_recv-child", default="", help=argparse.SUPPRESS)
     args = parser.parse_args()
 
+    if args.check and not args.two_process:
+        # the single-process bench shares one address space (~2x RSS by
+        # design) — a --check there would be meaningless, and silently
+        # skipping it would be a green CI signal with no guard evaluated
+        parser.error("--check requires --two-process (per-side RSS)")
     if args._recv_child:
         if args._recv_child.startswith("pg:"):
             _pg_recv_child(args._recv_child[3:], args.size_mb, args.timeout,
@@ -389,9 +421,22 @@ def main() -> None:
         return
     if args.two_process:
         if args.transport == "http":
-            bench_http_two_process(args.size_mb, args.num_chunks, args.timeout)
+            stats = bench_http_two_process(
+                args.size_mb, args.num_chunks, args.timeout
+            )
         else:  # "pg" — argparse choices exclude everything else
-            bench_pg_two_process(args.size_mb, args.timeout, args.inplace)
+            stats = bench_pg_two_process(args.size_mb, args.timeout, args.inplace)
+        if args.check:
+            over = {
+                k: v for k, v in stats.items()
+                if k.endswith("rss_x_payload") and v > args.rss_bound
+            }
+            if over:
+                sys.exit(
+                    f"RSS regression: {over} exceeds bound "
+                    f"{args.rss_bound}x payload — a streaming path is "
+                    "materializing the full checkpoint"
+                )
         return
 
     state = make_state(args.size_mb)

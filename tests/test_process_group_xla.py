@@ -12,6 +12,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -373,6 +374,49 @@ class TestDistributedMode:
             assert f"RANK{r} WORLD3 OK" in outs[r], outs[r]
         for r in range(2):
             assert f"RANK{r} RECONFIGURED OK" in outs[r], outs[r]
+
+    def test_failed_join_leaves_no_orphaned_service(self, monkeypatch):
+        """A join that raises in-process (client construction failure) must
+        shut rank 0's coordination service down and clear jax global state —
+        otherwise the next configure() rebinds over a live service still
+        holding the port. (The world-never-filled case is process-fatal on
+        this toolchain instead — covered by the restart-on-shrink design.)"""
+        import socket
+
+        from jax._src import distributed as _dist
+        from jax._src.lib import _jax as _jaxlib
+
+        from torchft_tpu.process_group_xla import _join_distributed_world
+
+        def _boom(*a, **k):
+            raise RuntimeError("client construction failed")
+
+        monkeypatch.setattr(
+            _jaxlib, "get_distributed_runtime_client", _boom
+        )
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        with pytest.raises(RuntimeError, match="client construction"):
+            _join_distributed_world(
+                f"127.0.0.1:{port}", rank=0, world_size=2, timeout=3
+            )
+        assert _dist.global_state.service is None
+        assert _dist.global_state.client is None
+        # the port must be free again: the service was really shut down
+        deadline = time.monotonic() + 10
+        while True:
+            probe = socket.socket()
+            try:
+                probe.bind(("0.0.0.0", port))
+                probe.close()
+                break
+            except OSError:
+                probe.close()
+                if time.monotonic() > deadline:
+                    pytest.fail(f"port {port} still held by orphaned service")
+                time.sleep(0.2)
 
     def test_abort_unblocks_peer(self, store):
         outs = _spawn_dist(store, 2, "abort")

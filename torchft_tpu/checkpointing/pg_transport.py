@@ -38,7 +38,19 @@ class PGTransport(CheckpointTransport[Any]):
 
     ``state_dict_template`` (optional callable returning a pytree) enables
     in-place receive: received leaves are placed onto the same device/sharding
-    as the template's leaves.
+    as the template's leaves; host ndarray template leaves are written
+    in place (``np.copyto``) so repeated heals reuse one allocation.
+
+    In-place contract (same as the reference's HBM in-place recv,
+    pg_transport.py:235-305): leaves land in the template AS THEY ARRIVE,
+    so a mid-stream failure (sender died, timeout) raises with the template
+    torn between old and new state. That is safe exactly when a failed heal
+    is never committed and is retried before the state is used — which the
+    Manager protocol guarantees (a recv_checkpoint exception reaches
+    ``report_error``, the step is discarded at should_commit, and the next
+    quorum heals again). Callers outside the Manager who hand their live
+    state as the template must either provide the same guarantee or pass a
+    scratch template.
     """
 
     def __init__(
@@ -127,13 +139,28 @@ class PGTransport(CheckpointTransport[Any]):
 
 
 def _place_like(host_leaf: np.ndarray, template: Any) -> Any:
-    """Put a host array onto the template leaf's device/sharding (in-place
-    receive equivalent: no extra host round-trip later)."""
+    """Land a received leaf where the template leaf lives.
+
+    - jax.Array template: ``device_put`` to its sharding (the JAX analog of
+      the reference's HBM-to-HBM in-place recv, pg_transport.py:235-305).
+    - Host ndarray template: copy INTO the template's buffer and return it,
+      so the wire buffer is freed per-leaf and repeated heals reuse one
+      allocation — receiver peak stays ~template + one leaf instead of
+      template + full checkpoint (measured at 12 GB in
+      benchmarks/transport_bench.py --two-process --inplace).
+    """
     try:
         import jax
 
         if isinstance(template, jax.Array):
             return jax.device_put(host_leaf.astype(template.dtype), template.sharding)
-    except Exception:  # noqa: BLE001 - fall back to host array
-        logger.exception("pg_transport: failed to place leaf on device")
+        if (
+            isinstance(template, np.ndarray)
+            and template.shape == host_leaf.shape
+            and template.flags.writeable
+        ):
+            np.copyto(template, host_leaf, casting="unsafe")
+            return template
+    except Exception:  # noqa: BLE001 - fall back to the wire buffer
+        logger.exception("pg_transport: failed to place leaf onto template")
     return host_leaf

@@ -146,16 +146,39 @@ def _join_distributed_world(
             shutdown_timeout=shutdown_to,
         )
 
-    client = _jaxlib.get_distributed_runtime_client(
-        coord, rank,
-        init_timeout=max(1, int(timeout)),
-        heartbeat_timeout=hb,
-        shutdown_timeout=shutdown_to,
-        shutdown_on_destruction=False,
-        use_compression=True,
-    )
-    logger.info("joining distributed world %s as %d/%d", coord, rank, world_size)
-    client.connect()
+    try:
+        client = _jaxlib.get_distributed_runtime_client(
+            coord, rank,
+            init_timeout=max(1, int(timeout)),
+            heartbeat_timeout=hb,
+            shutdown_timeout=shutdown_to,
+            shutdown_on_destruction=False,
+            use_compression=True,
+        )
+        logger.info(
+            "joining distributed world %s as %d/%d", coord, rank, world_size
+        )
+        client.connect()
+    except Exception:
+        # symmetric cleanup: a failed join must not strand rank 0's live
+        # service in jax global state — the next configure() would skip
+        # teardown (no world was built) and rebind over a service still
+        # holding the port and its threads. NOTE: on this toolchain the
+        # world-never-filled case is usually process-FATAL (client.h
+        # terminates on the registration deadline) rather than a Python
+        # exception — that death is the documented restart-on-shrink path;
+        # this cleanup covers the join failures that do raise in-process
+        # (client construction errors, toolchains where connect raises).
+        if rank == 0 and state.service is not None:
+            service, state.service = state.service, None
+            t = threading.Thread(
+                target=service.shutdown,
+                daemon=True,
+                name="pgxla_service_shutdown",
+            )
+            t.start()
+            t.join(5.0)  # bounded, like _teardown_distributed_world's
+        raise
     state.client = client
     state.process_id = rank
     state.num_processes = world_size

@@ -21,7 +21,7 @@ import time
 
 
 def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
-                     loss_chunk=0):
+                     loss_chunk=0, master_f32=False):
     """Compile and time the bf16 adamw train step; returns (tokens/s, mfu).
 
     One shared harness for bench.py and the sweep: jit with donated
@@ -29,21 +29,49 @@ def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
     platforms block_until_ready returns before execution completes — only a
     value fetch is a true barrier), then a timed loop chained through the
     donated state.
+
+    ``master_f32`` switches to the mixed-precision training recipe: master
+    params and adamw moments in f32, weights cast to bf16 at use so the
+    matmuls still hit the MXU at bf16 rate. The default (False) trains
+    pure-bf16 end to end — params, moments, and update arithmetic — which
+    is the historical headline configuration; the f32-master variant is the
+    numerically production-grade one and its measured cost is recorded in
+    docs/performance.md.
     """
     import jax
+    import jax.numpy as jnp
     import optax
 
     from torchft_tpu.models.llama import llama_init, llama_loss
     from torchft_tpu.utils import peak_flops_per_chip
 
     params = llama_init(jax.random.PRNGKey(0), cfg)
+    if master_f32:
+        compute_dtype = cfg.dtype
+        params = jax.tree.map(
+            lambda x: (x.astype(jnp.float32)
+                       if x.dtype == compute_dtype else x),
+            params,
+        )
+
+        def loss_fn(p, tokens, targets):
+            pb = jax.tree.map(
+                lambda x: (x.astype(compute_dtype)
+                           if x.dtype == jnp.float32 else x),
+                p,
+            )
+            return llama_loss(pb, tokens, targets, cfg, remat=remat,
+                              loss_chunk=loss_chunk)
+    else:
+        def loss_fn(p, tokens, targets):
+            return llama_loss(p, tokens, targets, cfg, remat=remat,
+                              loss_chunk=loss_chunk)
+
     tx = optax.adamw(lr)
     opt_state = tx.init(params)
 
     def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(llama_loss)(
-            params, tokens, targets, cfg, remat=remat, loss_chunk=loss_chunk
-        )
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -70,6 +98,20 @@ def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
     flops_per_token = 6 * cfg.num_params()  # fwd+bwd dense approximation
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
     return tokens_per_sec, mfu
+
+
+def peak_hbm_gb() -> "float | None":
+    """Peak device-memory use of the local chip in GiB, if the runtime
+    exposes it (TPU does via memory_stats; virtual CPU devices return None).
+    """
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return round(peak / 2**30, 2) if peak else None
+    except Exception:  # noqa: BLE001 - stats are best-effort decoration
+        return None
 
 
 def fault_tolerance_metrics(size_mb: int = 8, steps: int = 12, kill_at: int = 4,
@@ -189,6 +231,7 @@ def main() -> None:
 
     first_err = None
     result = None  # (tokens_per_sec, mfu, "requested:resolved")
+    clean_peak = True  # no failed mode allocated before the winner ran
     for mode in attention_modes:
         os.environ["TORCHFT_TPU_ATTENTION"] = mode
         try:
@@ -199,6 +242,7 @@ def main() -> None:
             # the first failure is the root cause (later modes usually fail
             # identically for non-attention errors)
             first_err = first_err or e
+            clean_peak = False
             print(f"# attention mode {mode!r} failed: {e}", file=sys.stderr)
     if result is None:
         raise first_err
@@ -218,6 +262,12 @@ def main() -> None:
         # the artifact, not just implied by the requested mode
         "attention_mode": mode,
     }
+    # peak_bytes_in_use is process-lifetime: a failed earlier attention mode
+    # that allocated before dying would inflate it, so only record the peak
+    # when the winning mode ran first (the normal case)
+    hbm = peak_hbm_gb() if clean_peak else None
+    if hbm is not None:
+        record["peak_hbm_gb"] = hbm
     if probe in ("hung", "crash"):
         # the number above is a CPU-fallback measurement, not the chip's
         detail = ("init hung (wedged tunnel?)" if probe == "hung"

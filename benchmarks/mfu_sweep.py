@@ -9,15 +9,16 @@ hardware:
     python benchmarks/mfu_sweep.py --blocks   # splash block-size sweep
 
 Every config runs in its OWN SUBPROCESS with a wall-clock timeout: a config
-that wedges the compiler (observed on this toolchain: remat="attn" with the
-splash kernel compiles >25 min and never returns) must cost one timeout, not
-the rest of the matrix. After any timeout the parent re-probes the backend
-and stops the sweep if the platform plugin itself has wedged — launching
-more compiles at a dead tunnel only deepens the wedge.
+that wedges the compiler (observed on the round-3 toolchain: remat="attn"
+with the splash kernel compiled >25 min and never returned) must cost one
+timeout, not the rest of the matrix. After any timeout the parent re-probes
+the backend and stops the sweep if the platform plugin itself has wedged —
+launching more compiles at a dead tunnel only deepens the wedge.
 
-remat="attn" is additionally skipped on TPU unless TORCHFT_TPU_SWEEP_ATTN=1:
-it is a KNOWN compiler-hang on the current toolchain (models/remat.py), and
-an opt-in flag beats rediscovering that one 20-minute timeout at a time.
+remat="attn" is skipped from the full matrix unless TORCHFT_TPU_SWEEP_ATTN=1
+(one observed compiler hang earns an opt-in gate even though the round-4
+toolchain compiles it fine — see models/remat.py for the measured history);
+targeted runs via --cell bypass the gate.
 """
 
 import argparse
@@ -37,16 +38,18 @@ from bench import timed_train_step
 from torchft_tpu.models.llama import CONFIGS
 from torchft_tpu.ops import attention as _attn
 tps, mfu = timed_train_step(CONFIGS[{cfg!r}], {batch}, {seq}, steps=10,
-                            remat={remat!r}, loss_chunk={chunk})
+                            remat={remat!r}, loss_chunk={chunk},
+                            master_f32={master_f32})
 print(f"RESULT {{tps:.1f}} {{mfu:.4f}} {{_attn.LAST_DISPATCH}}", flush=True)
 """
 
 
-def run_config(cfg, batch, seq, remat, chunk, env_extra, timeout_s):
+def run_config(cfg, batch, seq, remat, chunk, env_extra, timeout_s,
+               master_f32=False):
     """Run one sweep cell in a subprocess; returns a one-line verdict."""
     env = dict(os.environ, **env_extra)
     code = _CHILD.format(repo=REPO, cfg=cfg, batch=batch, seq=seq,
-                         remat=remat, chunk=chunk)
+                         remat=remat, chunk=chunk, master_f32=master_f32)
     try:
         out = subprocess.run([sys.executable, "-c", code], env=env,
                              capture_output=True, text=True, timeout=timeout_s)
@@ -84,21 +87,69 @@ def sweep(cells, timeout_s):
 
 
 def main():
-    import jax
-
-    if jax.default_backend() == "cpu":
-        sys.exit("mfu_sweep needs a TPU; the bench_350m config would grind "
-                 "for hours on CPU (use bench.py, which falls back to tiny).")
-
     ap = argparse.ArgumentParser()
     ap.add_argument("--blocks", action="store_true",
                     help="sweep splash block sizes instead of the remat matrix")
     ap.add_argument("--timeout", type=float, default=1200.0,
                     help="per-config wall-clock budget (compile + 10 steps)")
+    ap.add_argument("--cell", action="append", default=[],
+                    metavar="REMAT,BATCH,CHUNK[,mf32]",
+                    help="run only these cells (repeatable), e.g. "
+                         "--cell full,16,0 --cell attn,8,0 --cell "
+                         "full,8,0,mf32 (f32 master weights + moments); "
+                         "bypasses the TORCHFT_TPU_SWEEP_ATTN gate (an "
+                         "explicit cell is the opt-in)")
     args = ap.parse_args()
+
+    # validate cell specs BEFORE the backend probe: an argv typo must cost
+    # an argparse error, not a 90 s probe against a possibly-wedged tunnel
+    cell_specs = []
+    for spec in args.cell:
+        parts = spec.split(",")
+        if len(parts) < 3 or (len(parts) == 4 and parts[3] != "mf32") \
+                or len(parts) > 4:
+            ap.error(f"--cell {spec!r}: expected REMAT,BATCH,CHUNK with "
+                     "optional ',mf32' (e.g. full,8,0 or full,8,0,mf32)")
+        if parts[0] not in ("dots", "none", "full", "attn"):
+            ap.error(f"--cell {spec!r}: REMAT must be one of "
+                     "dots/none/full/attn")
+        try:
+            batch, chunk = int(parts[1]), int(parts[2])
+        except ValueError:
+            ap.error(f"--cell {spec!r}: BATCH and CHUNK must be integers")
+        cell_specs.append((parts[0], batch, chunk, len(parts) > 3))
+
+    # share one persistent compilation cache with every child: a re-run of
+    # the sweep (or the bench after it) replays cached executables instead
+    # of re-risking tunnel-wedging compiles. Sets JAX_COMPILATION_CACHE_DIR
+    # in os.environ, which run_config's children inherit. After argparse:
+    # --help must not pay a backend probe.
+    from torchft_tpu.utils import enable_compilation_cache, probe_backend
+
+    enable_compilation_cache()
+
+    # probe in a SUBPROCESS: the parent must not hold the TPU runtime open
+    # while its children compile against the same tunnelled chip
+    status, detail = probe_backend(90.0)
+    if status != "accel":
+        sys.exit(f"mfu_sweep needs a TPU (probe: {status} {detail}); the "
+                 "bench_350m config would grind for hours on CPU (use "
+                 "bench.py, which falls back to tiny).")
 
     cfg, seq = "bench_350m", 2048
     attn = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
+
+    if cell_specs:
+        cells = [
+            (f"attn={attn} remat={remat:5s} batch={batch:3d} "
+             f"chunk={chunk:4d}" + (" master=f32" if mf32 else ""),
+             {},
+             dict(cfg=cfg, batch=batch, seq=seq, remat=remat,
+                  chunk=chunk, master_f32=mf32))
+            for remat, batch, chunk, mf32 in cell_specs
+        ]
+        sweep(cells, args.timeout)
+        return
 
     if args.blocks:
         cells = [
@@ -114,8 +165,11 @@ def main():
     remats = ["dots", "none", "full", "attn"]
     if os.environ.get("TORCHFT_TPU_SWEEP_ATTN") != "1":
         remats.remove("attn")
-        print("# remat='attn' skipped: known compiler hang on this toolchain "
-              "(set TORCHFT_TPU_SWEEP_ATTN=1 to retry)", flush=True)
+        print("# remat='attn' skipped from the full matrix: it hung the "
+              "round-3 toolchain's compiler; round 4's compiles it fine "
+              "(0.436 MFU — slower than 'full') but one observed hang earns "
+              "an opt-in gate (TORCHFT_TPU_SWEEP_ATTN=1, or --cell attn,8,0)",
+              flush=True)
     cells = [
         (f"attn={attn} remat={remat:5s} batch={batch:3d} chunk={chunk:4d}",
          {},

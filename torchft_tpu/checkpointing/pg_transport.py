@@ -126,7 +126,19 @@ class PGTransport(CheckpointTransport[Any]):
             # two extra full-leaf copies)
             leaf = leaf_from_bytes(meta, buf[0])
             if template_leaves is not None and meta.kind == "array":
-                leaf = _place_like(leaf, template_leaves[i])
+                if i < len(template_leaves):
+                    leaf = _place_like(leaf, template_leaves[i])
+                else:
+                    # sender's tree outgrew the template (e.g. model gained
+                    # a layer since the template was built): same degraded
+                    # contract as a per-leaf mismatch — warn, keep the wire
+                    # buffer, never die mid-stream with a torn template
+                    logger.warning(
+                        "pg_transport: received leaf %d but template has "
+                        "only %d leaves; falling back to the wire buffer — "
+                        "in-place receive degraded",
+                        i, len(template_leaves),
+                    )
             payload_leaves.append(leaf)
 
         import jax
@@ -157,10 +169,26 @@ def _place_like(host_leaf: np.ndarray, template: Any) -> Any:
         if (
             isinstance(template, np.ndarray)
             and template.shape == host_leaf.shape
+            and template.dtype == host_leaf.dtype
             and template.flags.writeable
         ):
-            np.copyto(template, host_leaf, casting="unsafe")
+            np.copyto(template, host_leaf)
             return template
+        # a template that can't absorb the leaf silently costs the in-place
+        # property (receiver RSS regresses from ~0.01x to ~1x payload over
+        # repeated heals) — that degradation must be visible in logs
+        logger.warning(
+            "pg_transport: template leaf cannot absorb received leaf "
+            "(template %s shape=%s dtype=%s writeable=%s vs received "
+            "shape=%s dtype=%s); falling back to the wire buffer — "
+            "in-place receive degraded",
+            type(template).__name__,
+            getattr(template, "shape", None),
+            getattr(template, "dtype", None),
+            getattr(getattr(template, "flags", None), "writeable", None),
+            host_leaf.shape,
+            host_leaf.dtype,
+        )
     except Exception:  # noqa: BLE001 - fall back to the wire buffer
         logger.exception("pg_transport: failed to place leaf onto template")
     return host_leaf

@@ -20,11 +20,13 @@ On TPU the interesting trade is HBM capacity vs backward-pass FLOPs:
   recompute is disproportionately expensive (a full Pallas flash forward),
   while the dense matmuls recompute at MXU speed from residuals already in
   HBM — so this keeps nearly full-remat's memory footprint but removes the
-  most expensive third of the recompute. CAVEAT: as of July 2026 the
-  save-only-named-residuals policy wedges the TPU compiler (>25 min, never
-  returns) on the bench config with the splash kernel; it compiles and
-  runs fine on CPU and is numerically pinned by the grad-equivalence test.
-  Prefer "full" on TPU until a toolchain update clears it.
+  most expensive third of the recompute. History: the round-3 toolchain
+  wedged the TPU compiler on this policy with the splash kernel (>25 min,
+  never returned); the round-4 toolchain compiles and runs it fine but it
+  measures SLOWER than "full" on the bench config (0.436 vs 0.449 MFU) —
+  the step is HBM-bound, so keeping attention outputs resident costs more
+  bandwidth than their recompute costs FLOPs. Numerically pinned by the
+  grad-equivalence test.
 - "none": XLA saves all residuals.
 """
 

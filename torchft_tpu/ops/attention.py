@@ -158,7 +158,10 @@ def splash_attention_tpu(
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     S = qt.shape[2]
-    blk = next(b for b in (512, 256, 128) if S % b == 0)
+    # block 1024 is the measured winner on v5e (0.457 vs 0.449 MFU at 512;
+    # 2048 fails to compile — round-4 sweep, docs/performance.md); larger
+    # tiles amortize the online-softmax bookkeeping until VMEM runs out
+    blk = next(b for b in (1024, 512, 256, 128) if S % b == 0)
     # benchmark escape hatch: benchmarks/mfu_sweep.py sweeps this to find the
     # best tile for a given chip generation; training code leaves it unset
     blk_env = os.environ.get("TORCHFT_TPU_SPLASH_BLOCK")

@@ -59,8 +59,17 @@ def run_config(cfg, batch, seq, remat, chunk, env_extra, timeout_s,
         if line.startswith("RESULT "):
             _, tps, mfu, dispatch = line.split()
             return (float(tps), float(mfu), dispatch), None
-    tail = (out.stderr.strip() or out.stdout.strip())[-160:]
-    return None, f"FAILED rc={out.returncode}: {tail}"
+    # surface the actual exception, not whatever JAX printed last: the
+    # traceback's final exception line (or an XLA status code) is the root
+    # cause; a blind tail usually lands on JAX's frame-filtering notice
+    err_text = out.stderr.strip() or out.stdout.strip()
+    cause = ""
+    for line in reversed(err_text.splitlines()):
+        if any(m in line for m in ("Error", "RESOURCE_EXHAUSTED", "INTERNAL",
+                                   "INVALID_ARGUMENT", "UNIMPLEMENTED")):
+            cause = line.strip()[:300]
+            break
+    return None, f"FAILED rc={out.returncode}: {cause or err_text[-300:]}"
 
 
 def backend_alive() -> bool:

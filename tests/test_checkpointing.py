@@ -257,6 +257,76 @@ class TestPGTransport:
             store.shutdown()
 
 
+class TestInplaceDegradedPaths:
+    """A template that cannot absorb the incoming leaves must warn and fall
+    back to the wire buffer — never die mid-stream or silently coerce."""
+
+    def _roundtrip(self, state, template, tag):
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=10.0) for _ in range(2)]
+        try:
+            addr = f"127.0.0.1:{store.port}/{tag}"
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(lambda r: pgs[r].configure(addr, r, 2, 31),
+                            range(2)))
+            sender = PGTransport(pgs[0], timeout=10.0)
+            receiver = PGTransport(
+                pgs[1], timeout=10.0, state_dict_template=lambda: template
+            )
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fs = ex.submit(sender.send_checkpoint, [1], 0, state, 10.0)
+                fr = ex.submit(
+                    receiver.recv_checkpoint, 0, "<pg_transport>", 0, 10.0
+                )
+                fs.result(timeout=30)
+                return fr.result(timeout=30)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()
+
+    def test_host_template_absorbs_in_place(self):
+        state = {"w": np.arange(64, dtype=np.float32)}
+        template = {"w": np.zeros(64, dtype=np.float32)}
+        out = self._roundtrip(state, template, "inplace-ok")
+        assert out["w"] is template["w"]  # landed IN the template buffer
+        np.testing.assert_array_equal(out["w"], state["w"])
+
+    def test_dtype_mismatch_warns_and_keeps_values_exact(self, caplog):
+        state = {"w": np.arange(64, dtype=np.float32)}
+        template = {"w": np.zeros(64, dtype=np.int32)}  # same shape, wrong dtype
+        with caplog.at_level("WARNING",
+                             logger="torchft_tpu.checkpointing.pg_transport"):
+            out = self._roundtrip(state, template, "inplace-dtype")
+        assert out["w"] is not template["w"]  # no silent unsafe coercion
+        assert out["w"].dtype == np.float32
+        np.testing.assert_array_equal(out["w"], state["w"])
+        assert any("in-place receive degraded" in r.message
+                   for r in caplog.records)
+
+    def test_device_template_dtype_mismatch_warns_keeps_values(self, caplog):
+        state = {"w": np.arange(64, dtype=np.float32)}
+        template = {"w": jnp.zeros(64, dtype=jnp.bfloat16)}  # device, wrong dtype
+        with caplog.at_level("WARNING",
+                             logger="torchft_tpu.checkpointing.pg_transport"):
+            out = self._roundtrip(state, template, "inplace-dev-dtype")
+        assert out["w"].dtype == np.float32  # no silent astype truncation
+        np.testing.assert_array_equal(np.asarray(out["w"]), state["w"])
+        assert any("in-place receive degraded" in r.message
+                   for r in caplog.records)
+
+    def test_sender_tree_larger_than_template_warns_not_crashes(self, caplog):
+        state = {"a": np.ones(16, np.float32), "b": np.full(16, 2, np.float32)}
+        template = {"a": np.zeros(16, np.float32)}  # one leaf short
+        with caplog.at_level("WARNING",
+                             logger="torchft_tpu.checkpointing.pg_transport"):
+            out = self._roundtrip(state, template, "inplace-short")
+        np.testing.assert_array_equal(out["a"], state["a"])
+        np.testing.assert_array_equal(out["b"], state["b"])
+        assert any("in-place receive degraded" in r.message
+                   for r in caplog.records)
+
+
 def make_big_state():
     """Leaves above the raw-frame threshold, mixed dtypes incl bf16, plus a
     pickled non-array leaf — the streaming-path shapes."""

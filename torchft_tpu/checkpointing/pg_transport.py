@@ -119,6 +119,7 @@ class PGTransport(CheckpointTransport[Any]):
             template_leaves, _ = jax.tree_util.tree_flatten(template)
 
         payload_leaves = []
+        overflow = 0  # received leaves past the template's length
         for i, meta in enumerate(spec.leaves):
             buf = self._pg.recv(src_rank, tag=2).get_future().wait(timeout_s)
             # pass the received ndarray straight through: leaf_from_bytes's
@@ -131,15 +132,18 @@ class PGTransport(CheckpointTransport[Any]):
                 else:
                     # sender's tree outgrew the template (e.g. model gained
                     # a layer since the template was built): same degraded
-                    # contract as a per-leaf mismatch — warn, keep the wire
-                    # buffer, never die mid-stream with a torn template
-                    logger.warning(
-                        "pg_transport: received leaf %d but template has "
-                        "only %d leaves; falling back to the wire buffer — "
-                        "in-place receive degraded",
-                        i, len(template_leaves),
-                    )
+                    # contract as a per-leaf mismatch — keep the wire
+                    # buffer, never die mid-stream with a torn template;
+                    # warn ONCE after the loop (hundreds of identical lines
+                    # would bury the message on the recovery hot path)
+                    overflow += 1
             payload_leaves.append(leaf)
+        if overflow:
+            logger.warning(
+                "pg_transport: received %d leaves beyond the template's %d; "
+                "kept their wire buffers — in-place receive degraded",
+                overflow, len(template_leaves),
+            )
 
         import jax
 
@@ -165,7 +169,12 @@ def _place_like(host_leaf: np.ndarray, template: Any) -> Any:
         import jax
 
         if isinstance(template, jax.Array):
-            return jax.device_put(host_leaf.astype(template.dtype), template.sharding)
+            if template.dtype == host_leaf.dtype:
+                return jax.device_put(host_leaf, template.sharding)
+            # same no-silent-coercion contract as the host path below: an
+            # astype here would round/truncate the sender's values with no
+            # signal (the dtypes can drift when template and sender state
+            # were built from different recipes, e.g. f32-master vs bf16)
         if (
             isinstance(template, np.ndarray)
             and template.shape == host_leaf.shape

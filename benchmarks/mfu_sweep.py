@@ -161,12 +161,21 @@ def main():
         return
 
     if args.blocks:
+        # uniform tiles first (the headline dimension), then asymmetric
+        # q/kv combos around the measured uniform winner (1024): a smaller
+        # kv tile relieves VMEM pressure, a larger q tile amortizes the
+        # online-softmax bookkeeping
+        combos = [(blk, blk) for blk in (128, 256, 512, 1024, 2048)]
+        combos += [(1024, 512), (1024, 256), (512, 1024), (2048, 512),
+                   (2048, 1024)]
         cells = [
-            (f"attn=splash block={blk:4d} remat=full batch=8",
+            (f"attn=splash block_q={bq:4d} block_kv={bkv:4d} remat=full "
+             "batch=8",
              {"TORCHFT_TPU_ATTENTION": "splash",
-              "TORCHFT_TPU_SPLASH_BLOCK": str(blk)},
+              "TORCHFT_TPU_SPLASH_BLOCK": str(bq),
+              "TORCHFT_TPU_SPLASH_BLOCK_KV": str(bkv)},
              dict(cfg=cfg, batch=8, seq=seq, remat="full", chunk=0))
-            for blk in (128, 256, 512, 1024, 2048)
+            for bq, bkv in combos
         ]
         sweep(cells, args.timeout)
         return

@@ -145,6 +145,69 @@ class TestSplashAttention:
         assert np.isfinite(np.asarray(g)).all()
 
 
+class TestSplashBlockEnv:
+    """Tile-selection plumbing: the env escape hatches must reach the kernel
+    builder and reject non-dividing tiles. (Numerics across tile sizes are
+    the upstream kernel's contract, exercised on TPU by mfu_sweep --blocks;
+    multi-tile interpret mode is minutes-slow on a 1-vCPU host, so these
+    tests assert the selected tiles without executing.)"""
+
+    def _selected_blocks(self, monkeypatch, env):
+        from torchft_tpu.ops import attention as A
+
+        # isolate from the invoking shell (a TPU session that just ran
+        # mfu_sweep cells may have these exported)
+        monkeypatch.delenv("TORCHFT_TPU_SPLASH_BLOCK", raising=False)
+        monkeypatch.delenv("TORCHFT_TPU_SPLASH_BLOCK_KV", raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        seen = {}
+
+        def fake_kernel(n_q_heads, seq_len, block, block_kv, interpret):
+            seen.update(block=block, block_kv=block_kv)
+            raise _Stop()
+
+        class _Stop(Exception):
+            pass
+
+        monkeypatch.setattr(A, "_splash_kernel", fake_kernel)
+        q = jnp.zeros((1, 256, 2, 128), jnp.float32)
+        kv = jnp.zeros((1, 256, 1, 128), jnp.float32)
+        try:
+            A.splash_attention_tpu(q, kv, kv, None, interpret=True)
+        except _Stop:
+            pass
+        return seen
+
+    def test_asymmetric_env_reaches_kernel(self, monkeypatch):
+        seen = self._selected_blocks(
+            monkeypatch,
+            {"TORCHFT_TPU_SPLASH_BLOCK": "128",
+             "TORCHFT_TPU_SPLASH_BLOCK_KV": "64"},
+        )
+        assert seen == {"block": 128, "block_kv": 64}
+
+    def test_block_env_sets_both_dimensions(self, monkeypatch):
+        seen = self._selected_blocks(
+            monkeypatch, {"TORCHFT_TPU_SPLASH_BLOCK": "128"}
+        )
+        assert seen == {"block": 128, "block_kv": 128}
+
+    def test_default_prefers_largest_dividing_tile(self, monkeypatch):
+        seen = self._selected_blocks(monkeypatch, {})
+        # S=256: 1024 and 512 don't divide; 256 is the largest that does
+        assert seen == {"block": 256, "block_kv": 256}
+
+    def test_non_dividing_kv_tile_rejected(self, monkeypatch):
+        from torchft_tpu.ops import attention as A
+
+        monkeypatch.setenv("TORCHFT_TPU_SPLASH_BLOCK_KV", "96")
+        q = jnp.zeros((1, 256, 2, 128), jnp.float32)
+        kv = jnp.zeros((1, 256, 1, 128), jnp.float32)
+        with pytest.raises(ValueError, match="SPLASH_BLOCK_KV"):
+            A.splash_attention_tpu(q, kv, kv, None, interpret=True)
+
+
 @pytest.mark.slow  # compile-heavy (>5s on the 1-vCPU CI host)
 class TestSplashInModel:
     def test_llama_fwd_bwd_matches_xla(self):

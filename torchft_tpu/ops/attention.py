@@ -97,9 +97,14 @@ def flash_attention_tpu(
 
 
 @functools.lru_cache(maxsize=16)
-def _splash_kernel(n_q_heads: int, seq_len: int, block: int, interpret: bool):
+def _splash_kernel(n_q_heads: int, seq_len: int, block: int, block_kv: int,
+                   interpret: bool):
     """Build (and cache) a splash-attention kernel: mask construction and
     kernel specialization are trace-time work worth amortizing.
+
+    ``block`` tiles the query dimension, ``block_kv`` the key/value
+    dimension (asymmetric tiles let a sweep trade VMEM pressure on the KV
+    side against online-softmax bookkeeping on the Q side).
 
     Construction runs under ``ensure_compile_time_eval``: the kernel bakes
     mask partials as arrays, and if those were created inside an outer trace
@@ -115,15 +120,16 @@ def _splash_kernel(n_q_heads: int, seq_len: int, block: int, interpret: bool):
         [sm.CausalMask((seq_len, seq_len))] * n_q_heads
     )
     block = min(block, seq_len)
+    block_kv = min(block_kv, seq_len)
     bs = sk.BlockSizes(
         block_q=block,
-        block_kv=block,
-        block_kv_compute=block,
+        block_kv=block_kv,
+        block_kv_compute=block_kv,
         block_q_dkv=block,
-        block_kv_dkv=block,
-        block_kv_dkv_compute=block,
+        block_kv_dkv=block_kv,
+        block_kv_dkv_compute=block_kv,
         block_q_dq=block,
-        block_kv_dq=block,
+        block_kv_dq=block_kv,
     )
     with jax.ensure_compile_time_eval():
         return sk.make_splash_mha(
@@ -162,8 +168,9 @@ def splash_attention_tpu(
     # 2048 fails to compile — round-4 sweep, docs/performance.md); larger
     # tiles amortize the online-softmax bookkeeping until VMEM runs out
     blk = next(b for b in (1024, 512, 256, 128) if S % b == 0)
-    # benchmark escape hatch: benchmarks/mfu_sweep.py sweeps this to find the
-    # best tile for a given chip generation; training code leaves it unset
+    # benchmark escape hatch: benchmarks/mfu_sweep.py sweeps these to find
+    # the best tiles for a given chip generation; training code leaves them
+    # unset. BLOCK sets both dimensions, BLOCK_KV overrides the kv side.
     blk_env = os.environ.get("TORCHFT_TPU_SPLASH_BLOCK")
     if blk_env:
         blk = int(blk_env)
@@ -171,7 +178,16 @@ def splash_attention_tpu(
             raise ValueError(
                 f"TORCHFT_TPU_SPLASH_BLOCK={blk} does not divide seq_len {S}"
             )
-    kernel = _splash_kernel(qt.shape[1], S, blk, interpret)
+    blk_kv = blk
+    blk_kv_env = os.environ.get("TORCHFT_TPU_SPLASH_BLOCK_KV")
+    if blk_kv_env:
+        blk_kv = int(blk_kv_env)
+        if S % blk_kv != 0:
+            raise ValueError(
+                f"TORCHFT_TPU_SPLASH_BLOCK_KV={blk_kv} does not divide "
+                f"seq_len {S}"
+            )
+    kernel = _splash_kernel(qt.shape[1], S, blk, blk_kv, interpret)
     out = jax.vmap(kernel)(qt, kt, vt)  # [B, Hq, S, hd]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 

@@ -101,6 +101,13 @@ def main():
                     help="sweep splash block sizes instead of the remat matrix")
     ap.add_argument("--timeout", type=float, default=1200.0,
                     help="per-config wall-clock budget (compile + 10 steps)")
+    ap.add_argument("--unroll", type=int, default=0,
+                    help="set TORCHFT_TPU_SCAN_UNROLL for every cell "
+                         "(layer-scan unroll factor; 0 = leave unset)")
+    ap.add_argument("--seq", type=int, default=2048,
+                    help="sequence length (long-context cells: pair a "
+                         "longer --seq with a smaller batch and a nonzero "
+                         "CHUNK, e.g. --seq 8192 --cell full,2,512)")
     ap.add_argument("--cell", action="append", default=[],
                     metavar="REMAT,BATCH,CHUNK[,mf32]",
                     help="run only these cells (repeatable), e.g. "
@@ -145,13 +152,23 @@ def main():
                  "bench_350m config would grind for hours on CPU (use "
                  "bench.py, which falls back to tiny).")
 
-    cfg, seq = "bench_350m", 2048
+    cfg, seq = "bench_350m", args.seq
+    if args.unroll:
+        # children inherit os.environ through run_config
+        os.environ["TORCHFT_TPU_SCAN_UNROLL"] = str(args.unroll)
+
+    def _unroll_tag() -> str:
+        # seq/unroll are run-scoped, not cell-scoped — they must still be
+        # in every label or archived sweep lines from different runs are
+        # indistinguishable
+        return f" unroll={args.unroll}" if args.unroll else ""
     attn = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
 
     if cell_specs:
         cells = [
             (f"attn={attn} remat={remat:5s} batch={batch:3d} "
-             f"chunk={chunk:4d}" + (" master=f32" if mf32 else ""),
+             f"chunk={chunk:4d} seq={seq}"
+             + (" master=f32" if mf32 else "") + _unroll_tag(),
              {},
              dict(cfg=cfg, batch=batch, seq=seq, remat=remat,
                   chunk=chunk, master_f32=mf32))
@@ -164,13 +181,17 @@ def main():
         # uniform tiles first (the headline dimension), then asymmetric
         # q/kv combos around the measured uniform winner (1024): a smaller
         # kv tile relieves VMEM pressure, a larger q tile amortizes the
-        # online-softmax bookkeeping
+        # online-softmax bookkeeping. Tiles that don't divide --seq are
+        # filtered here — failing them in a child would burn a subprocess
+        # on a result knowable in the parent.
         combos = [(blk, blk) for blk in (128, 256, 512, 1024, 2048)]
         combos += [(1024, 512), (1024, 256), (512, 1024), (2048, 512),
                    (2048, 1024)]
+        combos = [(bq, bkv) for bq, bkv in combos
+                  if seq % bq == 0 and seq % bkv == 0]
         cells = [
             (f"attn=splash block_q={bq:4d} block_kv={bkv:4d} remat=full "
-             "batch=8",
+             f"batch=8 seq={seq}" + _unroll_tag(),
              {"TORCHFT_TPU_ATTENTION": "splash",
               "TORCHFT_TPU_SPLASH_BLOCK": str(bq),
               "TORCHFT_TPU_SPLASH_BLOCK_KV": str(bkv)},
@@ -189,7 +210,8 @@ def main():
               "an opt-in gate (TORCHFT_TPU_SWEEP_ATTN=1, or --cell attn,8,0)",
               flush=True)
     cells = [
-        (f"attn={attn} remat={remat:5s} batch={batch:3d} chunk={chunk:4d}",
+        (f"attn={attn} remat={remat:5s} batch={batch:3d} chunk={chunk:4d} "
+         f"seq={seq}" + _unroll_tag(),
          {},
          dict(cfg=cfg, batch=batch, seq=seq, remat=remat, chunk=chunk))
         for remat, batch, chunk in itertools.product(remats, [8, 16, 32], [0, 512])

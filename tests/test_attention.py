@@ -201,6 +201,7 @@ class TestSplashBlockEnv:
     def test_non_dividing_kv_tile_rejected(self, monkeypatch):
         from torchft_tpu.ops import attention as A
 
+        monkeypatch.delenv("TORCHFT_TPU_SPLASH_BLOCK", raising=False)
         monkeypatch.setenv("TORCHFT_TPU_SPLASH_BLOCK_KV", "96")
         q = jnp.zeros((1, 256, 2, 128), jnp.float32)
         kv = jnp.zeros((1, 256, 1, 128), jnp.float32)

@@ -16,6 +16,7 @@ Design for the TPU:
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -198,9 +199,14 @@ def llama_hidden(
     (everything except the lm_head projection — see `llama_loss`'s chunked
     path, which applies the head per sequence chunk)."""
     h = params["embed"][tokens]  # [B,S,D]
-    # scan over stacked layers: one compiled body, L iterations
+    # scan over stacked layers: one compiled body, L iterations.
+    # TORCHFT_TPU_SCAN_UNROLL (benchmark escape hatch, default 1) unrolls
+    # the layer loop N-wise — XLA can then overlap across layer boundaries
+    # at the cost of N x the body's compile time; benchmarks/mfu_sweep.py
+    # is where values compete, training code leaves it unset
     body = remat_wrap(make_llama_layer_body(cfg, attention_fn), remat)
-    h, _ = jax.lax.scan(body, h, params["layers"])
+    unroll = int(os.environ.get("TORCHFT_TPU_SCAN_UNROLL", "1"))
+    h, _ = jax.lax.scan(body, h, params["layers"], unroll=unroll)
     return _rmsnorm(h, params["final_norm"], cfg.norm_eps)
 
 

@@ -187,8 +187,16 @@ def main():
         combos = [(blk, blk) for blk in (128, 256, 512, 1024, 2048)]
         combos += [(1024, 512), (1024, 256), (512, 1024), (2048, 512),
                    (2048, 1024)]
+        dropped = [(bq, bkv) for bq, bkv in combos
+                   if seq % bq != 0 or seq % bkv != 0]
         combos = [(bq, bkv) for bq, bkv in combos
                   if seq % bq == 0 and seq % bkv == 0]
+        if dropped:
+            print(f"# dropped {len(dropped)} tile combos that don't divide "
+                  f"seq={seq}: {dropped}", flush=True)
+        if not combos:
+            sys.exit(f"--blocks: no tile in the ladder divides seq={seq} "
+                     "(tiles are multiples of 128)")
         cells = [
             (f"attn=splash block_q={bq:4d} block_kv={bkv:4d} remat=full "
              f"batch=8 seq={seq}" + _unroll_tag(),

@@ -83,6 +83,18 @@ def train(args) -> None:
     def save_state():
         return {"params": state["params"], "opt_state": state["opt_state"]}
 
+    # --transport pg mirrors the reference train_ddp default (PGTransport,
+    # train_ddp.py:91-110): healing rides a DEDICATED recovery PG that the
+    # Manager re-rendezvouses with every quorum (the host plane forbids
+    # mixing p2p and collective traffic on one PG generation, so unlike
+    # the reference the recovery PG is a separate instance).
+    transport = recovery_pg = None
+    if args.transport == "pg":
+        from torchft_tpu.checkpointing import PGTransport
+
+        recovery_pg = ProcessGroupHost(timeout=30.0)  # caller-owned
+        transport = PGTransport(recovery_pg, timeout=30.0)
+
     manager = Manager(
         pg=ProcessGroupHost(timeout=30.0),
         load_state_dict=load_state,
@@ -91,10 +103,24 @@ def train(args) -> None:
         replica_id=f"train_ddp_{replica_id}",
         lighthouse_addr=lighthouse,
         timeout=30.0,
+        checkpoint_transport=transport,
     )
 
     rng = np.random.RandomState(replica_id)
     print(f"[replica {replica_id}] starting at step {manager.current_step()}", flush=True)
+    try:
+        _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id)
+    finally:
+        manager.shutdown(wait=False)
+        if recovery_pg is not None:
+            recovery_pg.shutdown()  # PGTransport.shutdown never touches it
+
+
+def _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id) -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
     while manager.current_step() < args.steps:
         # synthetic batch, sharded per replica (DistributedSampler equivalent)
         x = jnp.asarray(rng.randn(args.batch_size, 32, 32, 3), jnp.float32)
@@ -117,7 +143,6 @@ def train(args) -> None:
             )
     w_sum = float(jnp.sum(jnp.abs(state["params"]["w2"])))
     print(f"[replica {replica_id}] done: w2_l1={w_sum:.6f}", flush=True)
-    manager.shutdown(wait=False)
 
 
 def demo(args) -> None:
@@ -138,6 +163,7 @@ def demo(args) -> None:
         return subprocess.Popen(
             [sys.executable, __file__, "--steps", str(args.steps),
              "--batch-size", str(args.batch_size),
+             "--transport", args.transport,
              "--virtual-chips", "1"],
             env=env,
         )
@@ -179,6 +205,10 @@ if __name__ == "__main__":
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--min-replica-size", type=int, default=1)
+    parser.add_argument("--transport", choices=["http", "pg"], default="http",
+                        help="live-healing transport: http (default) or pg "
+                             "(dedicated recovery process group, the "
+                             "reference train_ddp default)")
     parser.add_argument("--replica-id", type=int, default=0)
     parser.add_argument("--lighthouse", type=str, default="127.0.0.1:29510")
     parser.add_argument("--virtual-chips", type=int, default=0,

@@ -304,6 +304,31 @@ class TestInplaceDegradedPaths:
         assert any("in-place receive degraded" in r.message
                    for r in caplog.records)
 
+    def test_inplace_recv_lands_on_multidevice_sharding(self, cpu_devices):
+        """SURVEY hard-part #4 (healing while compiled): recovered state
+        must land with the template's NamedSharding over the mesh — a pure
+        data swap that can't invalidate jitted programs."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(cpu_devices[:8]).reshape(8), ("x",))
+        sharding = NamedSharding(mesh, P("x"))
+        template = {
+            "w": jax.device_put(jnp.zeros((16, 4), jnp.float32), sharding)
+        }
+        state = {"w": np.arange(64, dtype=np.float32).reshape(16, 4)}
+        step = jax.jit(lambda t: t["w"].sum())
+        step(template)  # compiled against the template's sharding
+
+        out = self._roundtrip(state, template, "inplace-sharded")
+        assert isinstance(out["w"], jax.Array)
+        assert out["w"].sharding == sharding
+        np.testing.assert_array_equal(np.asarray(out["w"]), state["w"])
+
+        # the healed tree must hit the SAME executable — sharding-identical
+        # arrays are a pure data swap, no retrace/recompile
+        assert float(step(out)) == float(np.sum(state["w"]))
+        assert step._cache_size() == 1
+
     def test_device_template_dtype_mismatch_warns_keeps_values(self, caplog):
         state = {"w": np.arange(64, dtype=np.float32)}
         template = {"w": jnp.zeros(64, dtype=jnp.bfloat16)}  # device, wrong dtype

@@ -437,6 +437,33 @@ class TestQuorumHappyPath:
         m.wait_quorum()
         assert pg.configure_count == 2
 
+    def test_transport_configured_with_pg_per_quorum(self):
+        m = make_manager(quorum=make_quorum(quorum_id=5))
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._test_transport.configure.call_count == 1
+        addr = m._test_transport.configure.call_args[0][0]
+        assert "/recovery/" in addr  # distinct namespace from the main PG
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._test_transport.configure.call_count == 1  # same quorum id
+
+    def test_failed_transport_configure_retries_next_quorum(self):
+        m = make_manager(quorum=make_quorum(quorum_id=5))
+        m._test_transport.configure.side_effect = [
+            RuntimeError("recovery store down"), None
+        ]
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.errored() is not None
+        # same quorum id again: the failed reconfigure must be retried, not
+        # skipped — otherwise every later heal runs on an unconfigured
+        # recovery PG
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._test_transport.configure.call_count == 2
+        assert m.current_quorum_id() == 5
+
 
 class TestHealing:
     def test_async_heal_is_nonparticipating(self):

@@ -36,6 +36,15 @@ class Runner:
     attempts: int = 3
     use_async_quorum: bool = True
     total_steps: int = NUM_STEPS
+    # "http" (default) or "pg" — heal over a dedicated recovery
+    # ProcessGroupHost via PGTransport, kept in quorum lockstep by the
+    # Manager's transport-configure hook; "pg-inplace" adds a state-dict
+    # template so received leaves land in preallocated buffers
+    transport: str = "http"
+    # fail this replica's transport.configure N times (transient recovery-
+    # store fault): recovery must come from the commit-failure quorum bump
+    # re-rendezvousing EVERY replica, not a one-sided retry
+    transport_configure_fails: int = 0
 
     def run(self) -> Dict[str, np.ndarray]:
         for attempt in range(self.attempts):
@@ -58,6 +67,37 @@ class Runner:
             return {"w": params["w"].copy()}
 
         pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
+        transport = None
+        if self.transport.startswith("pg"):
+            from torchft_tpu.checkpointing import PGTransport
+
+            template = None
+            if self.transport == "pg-inplace":
+                # must mirror _manager_state_dict's composite tree; the
+                # non-array torchft leaves are pickle-kind (skipped) but
+                # still hold tree positions
+                def template():
+                    return {
+                        "user": {"default": {"w": np.zeros_like(params["w"])}},
+                        "torchft": {"step": 0, "batches_committed": 0},
+                    }
+
+            transport = PGTransport(
+                ProcessGroupHost(timeout=10.0),  # dedicated recovery PG
+                timeout=10.0,
+                state_dict_template=template,
+            )
+            if self.transport_configure_fails:
+                real_configure = transport.configure
+                remaining = [self.transport_configure_fails]
+
+                def flaky_configure(*a, **k):
+                    if remaining[0] > 0:
+                        remaining[0] -= 1
+                        raise RuntimeError("injected recovery-store fault")
+                    return real_configure(*a, **k)
+
+                transport.configure = flaky_configure
         manager = Manager(
             pg=pg,
             load_state_dict=load_state,
@@ -68,6 +108,7 @@ class Runner:
             lighthouse_addr=self.lighthouse_addr,
             timeout=10.0,
             quorum_timeout=10.0,
+            checkpoint_transport=transport,
         )
         try:
             while manager.current_step() < self.total_steps:
@@ -82,6 +123,8 @@ class Runner:
                     "batches": manager.batches_committed()}
         finally:
             manager.shutdown(wait=False)
+            if transport is not None:
+                transport._pg.shutdown()  # the recovery PG is caller-owned
 
 
 def run_replicas(runners: List[Runner]):
@@ -159,6 +202,54 @@ class TestRecovery:
             [Runner(i, addr, injector, min_replica_size=1, attempts=4) for i in range(2)]
         )
         assert injector.count == 2
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+
+
+class TestPGTransportHealing:
+    """Healing over PGTransport with a dedicated recovery PG (the
+    reference's train_ddp.py default transport) — the Manager's per-quorum
+    transport-configure hook keeps the recovery PG's world in lockstep."""
+
+    def test_init_sync_heals_over_pg_transport(self, lighthouse):
+        injector = EventInjector()
+        addr = f"127.0.0.1:{lighthouse.port}"
+        results = run_replicas(
+            [Runner(i, addr, injector, min_replica_size=2, transport="pg")
+             for i in range(2)]
+        )
+        # replicas start with DIFFERENT params; init_sync must have healed
+        # over the PG transport to make them bitwise equal
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+
+    def test_crash_and_rejoin_heals_in_place(self, lighthouse):
+        injector = EventInjector().fail_at(replica=1, step=2)
+        addr = f"127.0.0.1:{lighthouse.port}"
+        results = run_replicas(
+            [Runner(i, addr, injector, min_replica_size=1,
+                    transport="pg-inplace")
+             for i in range(2)]
+        )
+        assert injector.count == 1
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+
+    def test_transient_configure_fault_recovers_via_quorum_bump(
+        self, lighthouse
+    ):
+        """One replica's transport.configure fails transiently: the step's
+        commit vote fails, the next quorum request carries
+        commit_failures>0, the lighthouse bumps quorum_id, and EVERY
+        replica re-rendezvouses under the new id (a one-sided same-id
+        retry would block on the collective mesh rendezvous forever)."""
+        injector = EventInjector()
+        addr = f"127.0.0.1:{lighthouse.port}"
+        results = run_replicas(
+            [Runner(0, addr, injector, min_replica_size=1, transport="pg",
+                    transport_configure_fails=1),
+             Runner(1, addr, injector, min_replica_size=1, transport="pg")]
+        )
         assert_params_equal(results)
         assert all(r["steps"] == NUM_STEPS for r in results)
 

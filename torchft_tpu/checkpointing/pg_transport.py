@@ -68,6 +68,22 @@ class PGTransport(CheckpointTransport[Any]):
     def metadata(self) -> str:
         return "<pg_transport>"
 
+    def configure(
+        self,
+        store_addr: str,
+        replica_rank: int,
+        replica_world_size: int,
+        quorum_id: int = 0,
+    ) -> None:
+        """Rendezvous the recovery PG with the current quorum (called by
+        the Manager after its own PG reconfigure; see
+        CheckpointTransport.configure). The recovery PG must be a separate
+        instance from the Manager's — the host plane rejects mixing p2p
+        and collective traffic on one generation."""
+        self._pg.configure(
+            store_addr, replica_rank, replica_world_size, quorum_id=quorum_id
+        )
+
     SEND_WINDOW = 4
 
     def send_checkpoint(

@@ -22,6 +22,26 @@ class CheckpointTransport(ABC, Generic[T]):
         """Opaque string other replicas use to connect to this transport
         (fetched via the manager's checkpoint_metadata RPC)."""
 
+    def configure(
+        self,
+        store_addr: str,
+        replica_rank: int,
+        replica_world_size: int,
+        quorum_id: int = 0,
+    ) -> None:
+        """Per-quorum reconfiguration hook, called by the Manager right
+        after it reconfigures its own process group (same membership, a
+        distinct ``.../recovery/...`` store prefix).
+
+        Default no-op: address-based transports (HTTP) don't care about
+        quorum membership. ``PGTransport`` forwards this to its recovery
+        process group so it rendezvouses with the new world — the host
+        plane forbids mixing p2p and collective traffic on one PG
+        generation (frame ordering), so unlike the reference's
+        train_ddp.py:91-110 the recovery PG must be a SEPARATE instance,
+        and this hook is what keeps it in lockstep with the quorum.
+        """
+
     @abstractmethod
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: T, timeout: "float | timedelta"

@@ -425,7 +425,6 @@ class Manager:
                 recover_dst_replica_ranks=quorum.recover_dst_replica_ranks,
             )
             try:
-                self._quorum_id = quorum.quorum_id
                 self._bump_metric("reconfigures")
                 with trace_span("torchft::manager::_pg::configure"):
                     self._pg.configure(
@@ -434,6 +433,28 @@ class Manager:
                         quorum.replica_world_size,
                         quorum_id=quorum.quorum_id,
                     )
+                # keep the checkpoint transport in lockstep with the quorum
+                # (no-op for address-based transports; PGTransport
+                # rendezvouses its recovery PG here). Distinct /recovery
+                # store namespace so the two meshes can't cross-wire.
+                with trace_span("torchft::manager::_transport::configure"):
+                    self._checkpoint_transport.configure(
+                        f"{quorum.store_address}/torchft/{quorum.quorum_id}"
+                        f"/recovery/{self._group_rank}",
+                        quorum.replica_rank,
+                        quorum.replica_world_size,
+                        quorum_id=quorum.quorum_id,
+                    )
+                # recorded only after BOTH configures succeed. On failure
+                # _quorum_id stays stale and the step's commit vote fails,
+                # so the next quorum request carries commit_failures>0 and
+                # the lighthouse bumps quorum_id (native/lighthouse.cc) —
+                # EVERY replica then re-rendezvouses under the new id.
+                # That bump, not a one-sided same-id retry, is what makes
+                # the retry collective (a lone replica re-running a
+                # blocking mesh rendezvous its peers skipped would just
+                # time out); tests/test_manager_integ.py pins the loop.
+                self._quorum_id = quorum.quorum_id
                 # flight-recorder reconfiguration boundary marker
                 # (reference: manager.py:729-733, 808-817)
                 from torchft_tpu.flight_recorder import recorder

@@ -63,6 +63,14 @@ class PGTransport(CheckpointTransport[Any]):
         self._timeout = (
             timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
         )
+        if state_dict_template is not None and not callable(state_dict_template):
+            # fail at construction, not on the first heal (where the
+            # TypeError would surface as an endlessly-retried heal error)
+            raise TypeError(
+                "state_dict_template must be a zero-arg callable returning "
+                "the template pytree, not the pytree itself "
+                f"(got {type(state_dict_template).__name__})"
+            )
         self._template_fn = state_dict_template
 
     def metadata(self) -> str:

@@ -46,7 +46,11 @@ class _Killed(Exception):
 
 
 @pytest.mark.slow
-def test_random_kills_converge_bitwise():
+@pytest.mark.parametrize("transport_kind", ["http", "pg"])
+def test_random_kills_converge_bitwise(transport_kind):
+    """Parametrized over the healing transport: "pg" puts the per-quorum
+    transport-configure hook and the dedicated recovery PG's rendezvous
+    under the same randomized kill schedule as the main protocol."""
     rng = random.Random(0xC0FFEE)
     lh = LighthouseServer(
         bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
@@ -68,6 +72,12 @@ def test_random_kills_converge_bitwise():
             def load(sd, params=params):
                 params["w"] = np.array(sd["w"], dtype=np.float32)
 
+            recovery_pg = transport = None
+            if transport_kind == "pg":
+                from torchft_tpu.checkpointing import PGTransport
+
+                recovery_pg = ProcessGroupHost(timeout=8.0)
+                transport = PGTransport(recovery_pg, timeout=8.0)
             manager = Manager(
                 pg=ProcessGroupHost(timeout=8.0),
                 load_state_dict=load,
@@ -78,8 +88,10 @@ def test_random_kills_converge_bitwise():
                 lighthouse_addr=f"127.0.0.1:{lh.port}",
                 timeout=8.0,
                 quorum_timeout=8.0,
+                checkpoint_transport=transport,
             )
             alive[rid].set()
+            died = False
             try:
                 while manager.current_step() < TARGET_STEPS:
                     if kill_flags[rid].is_set():
@@ -108,18 +120,20 @@ def test_random_kills_converge_bitwise():
                         with heal_lock:
                             heal_count[0] += 1
                 finals[rid] = params["w"].copy()
-                manager.shutdown(wait=False)
                 return
             except _Killed:
-                alive[rid].clear()
-                manager.shutdown(wait=False)
-                # restart delay: let the surviving quorum notice the death
-                time.sleep(rng.uniform(0.1, 0.5))
-                continue
+                died = True
             except BaseException:
                 alive[rid].clear()
-                manager.shutdown(wait=False)
                 raise
+            finally:
+                if died:
+                    alive[rid].clear()
+                manager.shutdown(wait=False)
+                if recovery_pg is not None:
+                    recovery_pg.shutdown()
+            # restart delay: let the surviving quorum notice the death
+            time.sleep(rng.uniform(0.1, 0.5))
 
     def chaos() -> None:
         deadline = time.monotonic() + CHAOS_SECONDS

@@ -72,10 +72,21 @@ def run(
     kill_at: int,
     plane: str = "host",
     collective_timeout: float = 5.0,
+    transport: str = "http",
 ) -> dict:
-    from torchft_tpu.checkpointing import HTTPTransport
+    """``transport``: "http" (default), "pg" (heal over a dedicated
+    recovery ProcessGroupHost via PGTransport), or "pg-inplace" (adds a
+    preallocated template so received leaves land in place)."""
+    from torchft_tpu.checkpointing import HTTPTransport, PGTransport
     from torchft_tpu.coordination import LighthouseServer
     from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost as _RecoveryPG
+
+    if transport not in ("http", "pg", "pg-inplace"):
+        # argparse guards only the CLI; programmatic callers (bench.py's
+        # child scripts) must not get a silently mislabeled record
+        raise ValueError(f"unknown transport {transport!r}: "
+                         "expected http | pg | pg-inplace")
 
     if plane == "device":
         import jax
@@ -124,10 +135,28 @@ def run(
             manager = None
             healed = [False]
 
-            transport = HTTPTransport(timeout=30.0)
+            recovery_pg = None
+            if transport.startswith("pg"):
+                template_fn = None
+                if transport == "pg-inplace":
+                    # mirrors _manager_state_dict's composite; non-array
+                    # torchft leaves are pickle-kind but hold positions
+                    def template_fn():
+                        return {
+                            "user": {"default": {"params": {
+                                "w": np.zeros(n_elem, dtype=np.float32)
+                            }}},
+                            "torchft": {"step": 0, "batches_committed": 0},
+                        }
+
+                recovery_pg = _RecoveryPG(timeout=30.0)
+                tx = PGTransport(recovery_pg, timeout=30.0,
+                                 state_dict_template=template_fn)
+            else:
+                tx = HTTPTransport(timeout=30.0)
             if attempts == 2:
                 # the rejoiner's heal transfer, isolated from quorum time
-                inner_recv = transport.recv_checkpoint
+                inner_recv = tx.recv_checkpoint
 
                 def timed_recv(*a, **k):
                     t0 = time.perf_counter()
@@ -135,7 +164,7 @@ def run(
                     heal_recv_s[0] = time.perf_counter() - t0
                     return out
 
-                transport.recv_checkpoint = timed_recv
+                tx.recv_checkpoint = timed_recv
 
             pg = make_pg(collective_timeout)
             if rid == 0:
@@ -153,7 +182,7 @@ def run(
                     lighthouse_addr=f"127.0.0.1:{lh.port}",
                     timeout=collective_timeout,
                     quorum_timeout=15.0,
-                    checkpoint_transport=transport,
+                    checkpoint_transport=tx,
                 )
                 if attempts == 1:
                     start_step_barrier.wait(timeout=60)
@@ -203,6 +232,8 @@ def run(
                 # NameError here mask the original failure.
                 if manager is not None and manager.current_step() >= steps:
                     manager.shutdown(wait=False)
+                if recovery_pg is not None:
+                    recovery_pg.shutdown()  # caller-owned (pg transports)
 
     barrier = threading.Barrier(2)
     with ThreadPoolExecutor(max_workers=2) as ex:
@@ -231,6 +262,7 @@ def run(
     )
     return {
         "plane": plane,
+        "transport": transport,
         "reconfigure_s": round(recovery, 3),  # legacy name (round<=3): e2e
         "recovery_s": round(recovery, 3),
         "detection_quorum_s": (
@@ -253,6 +285,8 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--kill-at", type=int, default=10)
     p.add_argument("--plane", choices=["host", "device"], default="host")
+    p.add_argument("--transport", choices=["http", "pg", "pg-inplace"],
+                   default="http")
     p.add_argument("--collective-timeout", type=float, default=5.0)
     args = p.parse_args()
     if args.plane == "device":
@@ -260,7 +294,7 @@ def main() -> None:
 
         force_virtual_cpu_devices(2)
     print(json.dumps(run(args.size_mb, args.steps, args.kill_at,
-                         plane=args.plane,
+                         plane=args.plane, transport=args.transport,
                          collective_timeout=args.collective_timeout)))
 
 

@@ -1,0 +1,36 @@
+"""Smoke the recovery-bench harness (benchmarks/recovery_bench.py) — the
+machinery behind bench.py's ft_* artifact fields. The http path runs in
+every driver bench; the PG-transport variants only run here, so a
+regression in them must fail CI, not the round artifact."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # spawns a two-replica fleet per case
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("transport", ["pg", "pg-inplace"])
+def test_recovery_bench_pg_transports(transport):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "recovery_bench.py"),
+         "--size-mb", "8", "--steps", "12", "--kill-at", "4",
+         "--transport", transport],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=REPO,
+    )
+    assert out.returncode == 0, (out.stderr or out.stdout)[-2000:]
+    import json
+
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["transport"] == transport
+    # the kill happened, the survivor recovered, and the rejoiner healed
+    # over the PG transport (heal_recv timed means recv_checkpoint ran)
+    assert rec["recovery_s"] > 0
+    assert rec["rejoin_s"] and rec["rejoin_s"] > 0
+    assert rec["heal_recv_s"] and rec["heal_recv_s"] > 0

@@ -139,15 +139,11 @@ def run(
             if transport.startswith("pg"):
                 template_fn = None
                 if transport == "pg-inplace":
-                    # mirrors _manager_state_dict's composite; non-array
-                    # torchft leaves are pickle-kind but hold positions
+                    # the Manager's own live composite (late-bound:
+                    # `manager` is assigned below) — leaf alignment with
+                    # the sender by construction
                     def template_fn():
-                        return {
-                            "user": {"default": {"params": {
-                                "w": np.zeros(n_elem, dtype=np.float32)
-                            }}},
-                            "torchft": {"step": 0, "batches_committed": 0},
-                        }
+                        return manager.state_dict_template()
 
                 recovery_pg = _RecoveryPG(timeout=30.0)
                 tx = PGTransport(recovery_pg, timeout=30.0,

@@ -119,6 +119,25 @@ def train(args) -> None:
             save_interval_steps=args.ckpt_every,
         )
 
+    # --transport pg: healing over a dedicated recovery PG with an
+    # IN-PLACE template — received leaves land directly on this replica's
+    # NamedShardings (HBM-to-HBM on real chips; load_state's device_put
+    # fallback then has nothing to repair). The template is the Manager's
+    # own live composite (late-bound: `manager` is assigned below), so
+    # leaf alignment with the sender's tree holds by construction — under
+    # --diloco the fragment state fns register on BOTH sides and the
+    # composite trees still match.
+    transport = recovery_pg = None
+    if args.transport == "pg":
+        from torchft_tpu.checkpointing import PGTransport
+
+        recovery_pg = ProcessGroupHost(timeout=args.timeout)  # caller-owned
+        transport = PGTransport(
+            recovery_pg,
+            timeout=args.timeout,
+            state_dict_template=lambda: manager.state_dict_template(),
+        )
+
     manager = Manager(
         pg=ProcessGroupHost(timeout=args.timeout),
         load_state_dict=load_state,
@@ -128,6 +147,7 @@ def train(args) -> None:
         replica_id=f"llama_hsdp_{replica_id}",
         lighthouse_addr=lighthouse,
         timeout=args.timeout,
+        checkpoint_transport=transport,
     )
 
     diloco = None
@@ -255,6 +275,8 @@ def train(args) -> None:
             if ckpt is not None:
                 ckpt.close()
             manager.shutdown(wait=False)
+            if recovery_pg is not None:
+                recovery_pg.shutdown()  # caller-owned (PGTransport never touches it)
     print(f"[replica {replica_id}] done", flush=True)
 
 
@@ -276,6 +298,7 @@ def demo(args) -> None:
             [sys.executable, __file__, "--config", args.config,
              "--steps", str(args.steps), "--virtual-chips", "4",
              "--fsdp", "2", "--sp", "1", "--tp", "2",
+             "--transport", args.transport,
              "--batch-size", str(args.batch_size), "--seq-len", str(args.seq_len)],
             env=env,
         )
@@ -322,6 +345,10 @@ if __name__ == "__main__":
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1)
     parser.add_argument("--min-replica-size", type=int, default=1)
+    parser.add_argument("--transport", choices=["http", "pg"], default="http",
+                        help="live-healing transport: http (default) or pg "
+                             "(dedicated recovery PG, in-place receive onto "
+                             "this replica's shardings)")
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--diloco", action="store_true",
                         help="semi-sync across groups (DiLoCo) instead of "

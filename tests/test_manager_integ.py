@@ -75,14 +75,11 @@ class Runner:
 
             template = None
             if self.transport == "pg-inplace":
-                # must mirror _manager_state_dict's composite tree; the
-                # non-array torchft leaves are pickle-kind (skipped) but
-                # still hold tree positions
+                # the Manager's own live composite (late-bound: `manager`
+                # is assigned below) — alignment with the sender's tree by
+                # construction, even when extra state fns register
                 def template():
-                    return {
-                        "user": {"default": {"w": np.zeros_like(params["w"])}},
-                        "torchft": {"step": 0, "batches_committed": 0},
-                    }
+                    return manager.state_dict_template()
 
             # "pg-baby": recovery PG in a killable child process — a
             # wedged heal can be aborted without losing the trainer
@@ -402,20 +399,15 @@ class TestDevicePlaneShardedHeal:
 
                 def load_state(sd, state=state, shard=shard, rid=rid):
                     w = sd["w"]
-                    delivered[rid].append(
-                        isinstance(w, jax.Array) and w.sharding == shard
-                    )
-                    if not (
-                        isinstance(w, jax.Array) and w.sharding == shard
-                    ):
+                    ok = isinstance(w, jax.Array) and w.sharding == shard
+                    delivered[rid].append(ok)
+                    if not ok:
                         w = jax.device_put(jnp.asarray(np.asarray(w)), shard)
                     state["w"] = w
 
-                def template(state=state):
-                    return {
-                        "user": {"default": {"w": state["w"]}},
-                        "torchft": {"step": 0, "batches_committed": 0},
-                    }
+                def template():
+                    # the Manager's live composite (late-bound `manager`)
+                    return manager.state_dict_template()
 
                 recovery_pg = ProcessGroupHost(timeout=10.0)
                 transport = PGTransport(

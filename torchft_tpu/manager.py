@@ -963,6 +963,19 @@ class Manager:
         periodic checkpoints (reference: manager.py:938-958)."""
         return {"step": self._step, "batches_committed": self._batches_committed}
 
+    def state_dict_template(self) -> Dict[str, Any]:
+        """The LIVE healing composite, for use as a PGTransport in-place
+        template: ``PGTransport(pg, state_dict_template=lambda:
+        manager.state_dict_template())`` (late-bound — construct the
+        transport first, the Manager after). Because sender and receiver
+        both build this exact tree from their registered state-dict fns,
+        the transport's index-based leaf alignment holds by construction —
+        including algorithm state like DiLoCo fragments, whose keys sort
+        BEFORE "default" in the flattened composite (hand-rolled templates
+        that guess the shape silently lose the in-place property when any
+        extra state fn is registered)."""
+        return self._manager_state_dict()
+
     def user_state_dict(self) -> Dict[str, Any]:
         """Every registered user state (trainer state, DiLoCo fragment
         globals + outer optimizer, LocalSGD backups, data position, ...)

@@ -34,7 +34,7 @@ def run_threads(fns):
 
 
 def make_manager(replica_id, lighthouse, state_holder, use_async_quorum=False,
-                 pg=None):
+                 pg=None, checkpoint_transport=None):
     def load_state(sd):
         state_holder["params"] = {
             k: np.asarray(v) for k, v in sd["params"].items()
@@ -53,6 +53,7 @@ def make_manager(replica_id, lighthouse, state_holder, use_async_quorum=False,
         lighthouse_addr=f"127.0.0.1:{lighthouse.port}",
         timeout=10.0,
         quorum_timeout=10.0,
+        checkpoint_transport=checkpoint_transport,
     )
 
 
@@ -120,31 +121,85 @@ class TestLocalSGDInteg:
 
     def test_diloco_recovery_after_crash(self, lighthouse):
         injector = EventInjector().fail_at(replica=1, step=1)
-
-        def replica(rid):
-            for attempt in range(3):
-                state = {"params": {"w": np.array([0.0], dtype=np.float32)}}
-                manager = make_manager(rid, lighthouse, state, use_async_quorum=False)
-                try:
-                    diloco = DiLoCo(
-                        manager, state["params"],
-                        outer_tx=optax.sgd(1.0), sync_every=SYNC_EVERY,
-                    )
-                    # re-register DiLoCo fragment state after recovery
-                    while manager.current_step() < STEPS // SYNC_EVERY:
-                        injector.check(rid, manager.current_step())
-                        state["params"] = {"w": state["params"]["w"] - 0.1}
-                        state["params"] = diloco.step(state["params"])
-                    return state["params"]["w"].copy()
-                except InjectedFailure:
-                    continue
-                finally:
-                    manager.shutdown(wait=False)
-            raise RuntimeError("attempts exhausted")
-
-        results = run_threads([lambda r=r: replica(r) for r in range(2)])
+        results = _diloco_crash_recovery(lighthouse, injector)
         assert injector.count == 1
         np.testing.assert_array_equal(results[0], results[1])
+
+
+def _diloco_crash_recovery(lighthouse, injector, make_transport=None):
+    """Two DiLoCo replicas, one crashing per the injector; returns final
+    params. ``make_transport()`` optionally returns (transport,
+    recovery_pg) per Manager incarnation — the late-bound
+    ``manager.state_dict_template`` pattern needs the manager assigned
+    after the transport, which this harness guarantees."""
+
+    def replica(rid):
+        for attempt in range(3):
+            state = {"params": {"w": np.array([0.0], dtype=np.float32)}}
+            transport = recovery_pg = None
+            if make_transport is not None:
+                transport, recovery_pg = make_transport(lambda: manager)
+            manager = make_manager(
+                rid, lighthouse, state, use_async_quorum=False,
+                checkpoint_transport=transport,
+            )
+            try:
+                diloco = DiLoCo(
+                    manager, state["params"],
+                    outer_tx=optax.sgd(1.0), sync_every=SYNC_EVERY,
+                )
+                # re-register DiLoCo fragment state after recovery
+                while manager.current_step() < STEPS // SYNC_EVERY:
+                    injector.check(rid, manager.current_step())
+                    state["params"] = {"w": state["params"]["w"] - 0.1}
+                    state["params"] = diloco.step(state["params"])
+                return state["params"]["w"].copy()
+            except InjectedFailure:
+                continue
+            finally:
+                manager.shutdown(wait=False)
+                if recovery_pg is not None:
+                    recovery_pg.shutdown()
+        raise RuntimeError("attempts exhausted")
+
+    return run_threads([lambda r=r: replica(r) for r in range(2)])
+
+
+class TestDiLoCoInplaceHeal:
+    def test_recovery_heals_in_place_with_fragment_state(
+        self, lighthouse, caplog
+    ):
+        """DiLoCo + PGTransport with the Manager-derived template: the
+        sender's composite includes fragment state (keys that sort BEFORE
+        "default"), and because BOTH sides build the template from their
+        registered fns the index alignment holds — every array leaf
+        absorbs into the template, zero degraded-path records (neither
+        the cannot-absorb warning nor the failed-to-place exception)."""
+        from torchft_tpu.checkpointing import PGTransport
+
+        injector = EventInjector().fail_at(replica=1, step=1)
+
+        def make_transport(get_manager):
+            recovery_pg = ProcessGroupHost(timeout=10.0)
+            transport = PGTransport(
+                recovery_pg, timeout=10.0,
+                state_dict_template=lambda: get_manager().state_dict_template(),
+            )
+            return transport, recovery_pg
+
+        with caplog.at_level(
+            "WARNING", logger="torchft_tpu.checkpointing.pg_transport"
+        ):
+            results = _diloco_crash_recovery(lighthouse, injector,
+                                             make_transport)
+        assert injector.count == 1
+        np.testing.assert_array_equal(results[0], results[1])
+        # ANY pg_transport warning/exception record means a leaf left the
+        # in-place path ("degraded" warnings AND "failed to place" errors);
+        # caplog captures every logger, so filter to the transport's
+        degraded = [r for r in caplog.records
+                    if r.name == "torchft_tpu.checkpointing.pg_transport"]
+        assert not degraded, [r.message for r in degraded]
 
 
 class TestStreamingDiLoCoScenarios:

@@ -352,6 +352,93 @@ class TestInplaceDegradedPaths:
                    for r in caplog.records)
 
 
+class TestHTTPInplace:
+    """The default transport's in-place receive: matching host leaves
+    stream from the socket DIRECTLY into the template's buffers; device
+    templates device_put; mismatches warn and degrade."""
+
+    def _roundtrip(self, state, template):
+        send = HTTPTransport(timeout=20.0, num_chunks=2)
+        recv = HTTPTransport(timeout=20.0, state_dict_template=lambda: template)
+        try:
+            send.send_checkpoint([1], 3, state, 20.0)
+            return recv.recv_checkpoint(0, send.metadata(), 3, 20.0)
+        finally:
+            send.shutdown()
+            recv.shutdown()
+
+    def test_host_template_absorbs_stream(self):
+        state = {"w": np.arange(64, dtype=np.float32),
+                 "b": np.full(32, 2.0, np.float32)}
+        template = {"w": np.zeros(64, np.float32), "b": np.zeros(32, np.float32)}
+        out = self._roundtrip(state, template)
+        assert out["w"] is template["w"]  # streamed INTO the template
+        assert out["b"] is template["b"]
+        np.testing.assert_array_equal(out["w"], state["w"])
+        np.testing.assert_array_equal(out["b"], state["b"])
+
+    def test_device_template_lands_on_sharding(self, cpu_devices):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(cpu_devices[:4]).reshape(4), ("x",))
+        sharding = NamedSharding(mesh, P("x"))
+        template = {"w": jax.device_put(jnp.zeros((8, 2), jnp.float32), sharding)}
+        state = {"w": np.arange(16, dtype=np.float32).reshape(8, 2)}
+        out = self._roundtrip(state, template)
+        assert isinstance(out["w"], jax.Array)
+        assert out["w"].sharding == sharding
+        np.testing.assert_array_equal(np.asarray(out["w"]), state["w"])
+
+    def test_dtype_mismatch_warns_keeps_values(self, caplog):
+        state = {"w": np.arange(64, dtype=np.float32)}
+        template = {"w": np.zeros(64, np.int32)}
+        with caplog.at_level(
+            "WARNING", logger="torchft_tpu.checkpointing.http_transport"
+        ):
+            out = self._roundtrip(state, template)
+        assert out["w"] is not template["w"]
+        assert out["w"].dtype == np.float32
+        np.testing.assert_array_equal(out["w"], state["w"])
+        assert any("in-place receive degraded" in r.message
+                   for r in caplog.records)
+
+    def test_sender_tree_larger_than_template_warns(self, caplog):
+        state = {"a": np.ones(16, np.float32), "b": np.full(16, 2, np.float32)}
+        template = {"a": np.zeros(16, np.float32)}
+        with caplog.at_level(
+            "WARNING", logger="torchft_tpu.checkpointing.http_transport"
+        ):
+            out = self._roundtrip(state, template)
+        np.testing.assert_array_equal(out["a"], state["a"])
+        np.testing.assert_array_equal(out["b"], state["b"])
+        assert any("in-place receive degraded" in r.message
+                   for r in caplog.records)
+
+    def test_non_callable_template_rejected(self):
+        with pytest.raises(TypeError, match="zero-arg callable"):
+            HTTPTransport(state_dict_template={"w": np.zeros(4)})
+
+    def test_structural_drift_never_streams_into_wrong_buffers(self, caplog):
+        """Shape-coincident structural drift (sender gained a key) must
+        degrade the WHOLE receive — index-aligned placement would stream
+        the sender's 'b' leaf into the template's 'c' buffer."""
+        state = {"a": np.full(16, 1.0, np.float32),
+                 "b": np.full(16, 2.0, np.float32)}
+        template = {"a": np.zeros(16, np.float32),
+                    "c": np.zeros(16, np.float32)}  # same count, drifted keys
+        with caplog.at_level(
+            "WARNING", logger="torchft_tpu.checkpointing.http_transport"
+        ):
+            out = self._roundtrip(state, template)
+        # data correct, and NO template buffer was written
+        np.testing.assert_array_equal(out["a"], state["a"])
+        np.testing.assert_array_equal(out["b"], state["b"])
+        np.testing.assert_array_equal(template["a"], 0.0)
+        np.testing.assert_array_equal(template["c"], 0.0)
+        assert any("tree structure differs" in r.message
+                   for r in caplog.records)
+
+
 def make_big_state():
     """Leaves above the raw-frame threshold, mixed dtypes incl bf16, plus a
     pickled non-array leaf — the streaming-path shapes."""

@@ -32,9 +32,12 @@ from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing._serialization import (
     TreeSpecPayload,
     alloc_leaf,
+    can_absorb,
     flatten_state,
     payload_memoryview,
+    place_leaf_like,
     split_chunks,
+    template_leaves_for,
     unflatten_state,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
@@ -54,12 +57,35 @@ class HTTPTransport(CheckpointTransport[Any]):
     """Serve checkpoints over HTTP; receive with parallel chunk fetch.
 
     ``num_chunks=0`` serves everything as one chunk.
+
+    ``state_dict_template`` (zero-arg callable returning a pytree, same
+    contract as PGTransport's) enables in-place receive: a matching host
+    ndarray leaf streams from the socket DIRECTLY into the template's
+    buffer (no wire allocation), a jax.Array leaf lands via ``device_put``
+    on the template's sharding. Leaves are written AS THEY ARRIVE, so a
+    mid-stream failure leaves the template torn — even mid-leaf on this
+    direct-stream path. That is safe only under the Manager's
+    discard-and-retry heal protocol (a failed recv is reported, the step
+    discarded, the heal retried); do not hand live state to a template
+    outside that protocol. Structural drift between sender and template
+    degrades the WHOLE receive to wire buffers with one warning (see
+    ``template_leaves_for``).
     """
 
     def __init__(self, timeout: "float | timedelta" = 60.0, num_chunks: int = 0,
-                 hostname: str = "") -> None:
+                 hostname: str = "",
+                 state_dict_template: "Optional[Any]" = None) -> None:
         self._timeout = _to_seconds(timeout)
         self._num_chunks = num_chunks
+        if state_dict_template is not None and not callable(state_dict_template):
+            # same contract (and failure mode) as PGTransport: fail at
+            # construction, not as an endlessly-retried heal error
+            raise TypeError(
+                "state_dict_template must be a zero-arg callable returning "
+                "the template pytree, not the pytree itself "
+                f"(got {type(state_dict_template).__name__})"
+            )
+        self._template_fn = state_dict_template
         # advertised heal address: overridable for fleets where
         # gethostname() is not peer-resolvable (e.g. k8s pods)
         self._hostname = hostname
@@ -266,6 +292,26 @@ class HTTPTransport(CheckpointTransport[Any]):
         spec, num_chunks = pickle.loads(fetch(f"{base}/metadata"))
         payloads: List[Optional[Any]] = [None] * len(spec.leaves)
 
+        template_leaves: Optional[List[Any]] = None
+        if self._template_fn is not None:
+            # returns None (one warning) when the sender's tree STRUCTURE
+            # differs from the template's — index-aligned placement would
+            # risk streaming leaves into the wrong buffers
+            template_leaves = template_leaves_for(
+                spec, self._template_fn(), logger
+            )
+
+        def _host_target(meta, leaf_idx):
+            """A host ndarray template leaf that can absorb this wire leaf
+            lets the socket stream DIRECTLY into the resident buffer —
+            zero wire-buffer alloc, the strongest in-place path."""
+            if template_leaves is None or meta.kind != "array":
+                return None
+            t = template_leaves[leaf_idx]
+            if can_absorb(t, meta.shape, meta.dtype, require_contiguous=True):
+                return t
+            return None
+
         def fetch_chunk(i: int) -> None:
             """Stream one chunk: read each [leaf_idx, nbytes] frame, then
             read the body straight into the leaf's final array."""
@@ -279,7 +325,8 @@ class HTTPTransport(CheckpointTransport[Any]):
                     leaf_idx, nbytes = _FRAME.unpack(hdr)
                     meta = spec.leaves[leaf_idx]
                     if meta.kind == "array":
-                        arr = alloc_leaf(meta)
+                        target = _host_target(meta, leaf_idx)
+                        arr = target if target is not None else alloc_leaf(meta)
                         mv = memoryview(arr.reshape(-1).view("u1"))
                         got = 0
                         while got < nbytes:
@@ -289,6 +336,12 @@ class HTTPTransport(CheckpointTransport[Any]):
                                     f"chunk {i} truncated at leaf {leaf_idx}"
                                 )
                             got += n
+                        if target is None and template_leaves is not None:
+                            # device template (device_put) or a mismatch
+                            # (warns "in-place receive degraded")
+                            arr = place_leaf_like(
+                                arr, template_leaves[leaf_idx], logger
+                            )
                         payloads[leaf_idx] = arr
                     else:
                         payloads[leaf_idx] = r.read(nbytes)

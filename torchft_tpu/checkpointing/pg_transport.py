@@ -24,6 +24,8 @@ from torchft_tpu.checkpointing._serialization import (
     TreeSpecPayload,
     flatten_state,
     leaf_from_bytes,
+    place_leaf_like,
+    template_leaves_for,
 )
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.process_group import ProcessGroup
@@ -137,13 +139,14 @@ class PGTransport(CheckpointTransport[Any]):
 
         template_leaves: Optional[List[Any]] = None
         if self._template_fn is not None:
-            import jax
-
-            template = self._template_fn()
-            template_leaves, _ = jax.tree_util.tree_flatten(template)
+            # returns None (one warning) when the sender's tree STRUCTURE
+            # differs from the template's — index-aligned placement would
+            # risk streaming leaves into the wrong buffers
+            template_leaves = template_leaves_for(
+                spec, self._template_fn(), logger
+            )
 
         payload_leaves = []
-        overflow = 0  # received leaves past the template's length
         for i, meta in enumerate(spec.leaves):
             buf = self._pg.recv(src_rank, tag=2).get_future().wait(timeout_s)
             # pass the received ndarray straight through: leaf_from_bytes's
@@ -151,23 +154,8 @@ class PGTransport(CheckpointTransport[Any]):
             # two extra full-leaf copies)
             leaf = leaf_from_bytes(meta, buf[0])
             if template_leaves is not None and meta.kind == "array":
-                if i < len(template_leaves):
-                    leaf = _place_like(leaf, template_leaves[i])
-                else:
-                    # sender's tree outgrew the template (e.g. model gained
-                    # a layer since the template was built): same degraded
-                    # contract as a per-leaf mismatch — keep the wire
-                    # buffer, never die mid-stream with a torn template;
-                    # warn ONCE after the loop (hundreds of identical lines
-                    # would bury the message on the recovery hot path)
-                    overflow += 1
+                leaf = place_leaf_like(leaf, template_leaves[i], logger)
             payload_leaves.append(leaf)
-        if overflow:
-            logger.warning(
-                "pg_transport: received %d leaves beyond the template's %d; "
-                "kept their wire buffers — in-place receive degraded",
-                overflow, len(template_leaves),
-            )
 
         import jax
 
@@ -178,50 +166,3 @@ class PGTransport(CheckpointTransport[Any]):
         pass  # the PG is owned by the caller
 
 
-def _place_like(host_leaf: np.ndarray, template: Any) -> Any:
-    """Land a received leaf where the template leaf lives.
-
-    - jax.Array template: ``device_put`` to its sharding (the JAX analog of
-      the reference's HBM-to-HBM in-place recv, pg_transport.py:235-305).
-    - Host ndarray template: copy INTO the template's buffer and return it,
-      so the wire buffer is freed per-leaf and repeated heals reuse one
-      allocation — receiver peak stays ~template + one leaf instead of
-      template + full checkpoint (measured at 12 GB in
-      benchmarks/transport_bench.py --two-process --inplace).
-    """
-    try:
-        import jax
-
-        if isinstance(template, jax.Array):
-            if template.dtype == host_leaf.dtype:
-                return jax.device_put(host_leaf, template.sharding)
-            # same no-silent-coercion contract as the host path below: an
-            # astype here would round/truncate the sender's values with no
-            # signal (the dtypes can drift when template and sender state
-            # were built from different recipes, e.g. f32-master vs bf16)
-        if (
-            isinstance(template, np.ndarray)
-            and template.shape == host_leaf.shape
-            and template.dtype == host_leaf.dtype
-            and template.flags.writeable
-        ):
-            np.copyto(template, host_leaf)
-            return template
-        # a template that can't absorb the leaf silently costs the in-place
-        # property (receiver RSS regresses from ~0.01x to ~1x payload over
-        # repeated heals) — that degradation must be visible in logs
-        logger.warning(
-            "pg_transport: template leaf cannot absorb received leaf "
-            "(template %s shape=%s dtype=%s writeable=%s vs received "
-            "shape=%s dtype=%s); falling back to the wire buffer — "
-            "in-place receive degraded",
-            type(template).__name__,
-            getattr(template, "shape", None),
-            getattr(template, "dtype", None),
-            getattr(getattr(template, "flags", None), "writeable", None),
-            host_leaf.shape,
-            host_leaf.dtype,
-        )
-    except Exception:  # noqa: BLE001 - fall back to the wire buffer
-        logger.exception("pg_transport: failed to place leaf onto template")
-    return host_leaf

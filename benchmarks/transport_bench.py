@@ -248,7 +248,8 @@ def _pg_recv_child(addr: str, size_mb: int, timeout: float, inplace: bool) -> No
     _verify_and_report_recv(got, dt, delta)
 
 
-def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> dict:
+def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float,
+                           inplace: bool = False) -> dict:
     """Per-SIDE peak RSS (the streaming bound is ~1x payload + one leaf per
     side; the single-process bench necessarily shows ~2x because both ends
     share one address space). Parent stages + serves; a fresh child fetches
@@ -271,7 +272,9 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> dic
                 [sys.executable, os.path.abspath(__file__), "--transport",
                  "http", "--size-mb", str(size_mb),
                  "--num-chunks", str(num_chunks),
-                 "--timeout", str(timeout), "--_recv-child", send.metadata()],
+                 "--timeout", str(timeout),
+                 *(["--inplace"] if inplace else []),
+                 "--_recv-child", send.metadata()],
                 capture_output=True, text=True,
                 # budget beyond the fetch timeout: interpreter/numpy startup
                 # and the post-measurement payload verification
@@ -290,6 +293,7 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> dic
     stats = {
         "transport": "http-2proc",
         "size_mb": size_mb,
+        "inplace": inplace,
         "seconds": recv_stats["seconds"],
         "gb_per_s": round(size_mb / 1024 / recv_stats["seconds"], 3),
         "sender_stage_rss_x_payload": round(sender_delta / payload_mb, 2),
@@ -301,11 +305,16 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float) -> dic
     return stats
 
 
-def _recv_child(metadata: str, size_mb: int, num_chunks: int, timeout: float) -> None:
+def _recv_child(metadata: str, size_mb: int, num_chunks: int, timeout: float,
+                inplace: bool = False) -> None:
     """Receiver half of the two-process bench: fetch, verify, report RSS."""
     from torchft_tpu.checkpointing import HTTPTransport
 
-    recv = HTTPTransport(timeout=timeout, num_chunks=num_chunks)
+    template = make_template(size_mb) if inplace else None
+    recv = HTTPTransport(
+        timeout=timeout, num_chunks=num_chunks,
+        state_dict_template=(lambda: template) if inplace else None,
+    )
     try:
         rss0 = _rss_mb()
         t0 = time.perf_counter()
@@ -398,7 +407,7 @@ def main() -> None:
     parser.add_argument("--num-chunks", type=int, default=8,
                         help="http parallel chunk fetches")
     parser.add_argument("--inplace", action="store_true",
-                        help="pg: receive into a preallocated template")
+                        help="pg/http: receive into a preallocated template")
     parser.add_argument("--timeout", type=float, default=600.0)
     parser.add_argument("--two-process", action="store_true",
                         help="http/pg: sender and receiver in separate "
@@ -424,13 +433,17 @@ def main() -> None:
         # design) — a --check there would be meaningless, and silently
         # skipping it would be a green CI signal with no guard evaluated
         parser.error("--check requires --two-process (per-side RSS)")
+    if args.inplace and args.transport == "http" and not args.two_process:
+        # the single-process http bench has no template path; silently
+        # dropping the flag would report a non-inplace run as requested
+        parser.error("--transport http --inplace requires --two-process")
     if args._recv_child:
         if args._recv_child.startswith("pg:"):
             _pg_recv_child(args._recv_child[3:], args.size_mb, args.timeout,
                            args.inplace)
         else:
             _recv_child(args._recv_child, args.size_mb, args.num_chunks,
-                        args.timeout)
+                        args.timeout, args.inplace)
         return
     if args.transport == "allreduce":
         bench_allreduce(args.size_mb, args.timeout)
@@ -438,7 +451,7 @@ def main() -> None:
     if args.two_process:
         if args.transport == "http":
             stats = bench_http_two_process(
-                args.size_mb, args.num_chunks, args.timeout
+                args.size_mb, args.num_chunks, args.timeout, args.inplace
             )
         else:  # "pg" — argparse choices exclude everything else
             stats = bench_pg_two_process(args.size_mb, args.timeout, args.inplace)
@@ -452,7 +465,7 @@ def main() -> None:
 
             def bound_for(key: str) -> float:
                 # gate on the stat the run actually produced, not the raw
-                # flag: --inplace is meaningless for http (ignored there)
+                # flag (both http and pg two-process runs report it)
                 if stats.get("inplace") and key == "receiver_rss_x_payload":
                     return max(args.inplace_recv_bound, leaf_x_payload)
                 return args.rss_bound

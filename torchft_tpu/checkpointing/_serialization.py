@@ -227,12 +227,15 @@ def template_leaves_for(spec: TreeSpecPayload, template: Any,
     # unflatten on the same exception — fail fast before moving bytes
     s_def = pickle.loads(spec.treedef_bytes)
     if s_def != t_def:
+        # show the structures, not just counts: the guard's canonical case
+        # is shape-coincident KEY drift, where the counts are equal and a
+        # counts-only message would read as spurious
         logger.warning(
-            "sender tree structure differs from the template's "
-            "(%d leaves vs %d) — index-aligned in-place placement would "
-            "risk landing leaves in the wrong buffers; in-place receive "
-            "degraded to wire buffers for this transfer",
-            s_def.num_leaves, len(t_leaves),
+            "sender tree structure differs from the template's — "
+            "index-aligned in-place placement would risk landing leaves "
+            "in the wrong buffers; in-place receive degraded to wire "
+            "buffers for this transfer (sender %.200s vs template %.200s)",
+            s_def, t_def,
         )
         return None
     return t_leaves

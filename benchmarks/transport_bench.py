@@ -433,9 +433,12 @@ def main() -> None:
         # design) — a --check there would be meaningless, and silently
         # skipping it would be a green CI signal with no guard evaluated
         parser.error("--check requires --two-process (per-side RSS)")
-    if args.inplace and args.transport == "http" and not args.two_process:
+    if (args.inplace and args.transport == "http" and not args.two_process
+            and not args._recv_child):
         # the single-process http bench has no template path; silently
-        # dropping the flag would report a non-inplace run as requested
+        # dropping the flag would report a non-inplace run as requested.
+        # (_recv_child IS the receiver half of a two-process run — it gets
+        # --inplace without --two-process and must not trip this guard.)
         parser.error("--transport http --inplace requires --two-process")
     if args._recv_child:
         if args._recv_child.startswith("pg:"):

@@ -119,21 +119,29 @@ def train(args) -> None:
             save_interval_steps=args.ckpt_every,
         )
 
-    # --transport pg: healing over a dedicated recovery PG with an
-    # IN-PLACE template — received leaves land directly on this replica's
-    # NamedShardings (HBM-to-HBM on real chips; load_state's device_put
-    # fallback then has nothing to repair). The template is the Manager's
-    # own live composite (late-bound: `manager` is assigned below), so
-    # leaf alignment with the sender's tree holds by construction — under
+    # Both transports heal with an IN-PLACE template: received leaves land
+    # directly on this replica's NamedShardings (HBM-to-HBM on real chips;
+    # load_state's device_put fallback then has nothing to repair — safe
+    # under async quorum because device-leaf templates never mutate live
+    # buffers at receive time). The template is the Manager's own live
+    # composite (late-bound: `manager` is assigned below), so leaf
+    # alignment with the sender's tree holds by construction — under
     # --diloco the fragment state fns register on BOTH sides and the
     # composite trees still match.
-    transport = recovery_pg = None
+    recovery_pg = None
     if args.transport == "pg":
         from torchft_tpu.checkpointing import PGTransport
 
         recovery_pg = ProcessGroupHost(timeout=args.timeout)  # caller-owned
         transport = PGTransport(
             recovery_pg,
+            timeout=args.timeout,
+            state_dict_template=lambda: manager.state_dict_template(),
+        )
+    else:
+        from torchft_tpu.checkpointing import HTTPTransport
+
+        transport = HTTPTransport(
             timeout=args.timeout,
             state_dict_template=lambda: manager.state_dict_template(),
         )

@@ -227,15 +227,33 @@ def template_leaves_for(spec: TreeSpecPayload, template: Any,
     # unflatten on the same exception — fail fast before moving bytes
     s_def = pickle.loads(spec.treedef_bytes)
     if s_def != t_def:
-        # show the structures, not just counts: the guard's canonical case
-        # is shape-coincident KEY drift, where the counts are equal and a
-        # counts-only message would read as spurious
+        # point at the first DIVERGING leaf path: the guard's canonical
+        # case is shape-coincident KEY drift, where counts are equal and
+        # truncated treedef reprs would print identical-looking prefixes
+        def leaf_paths(treedef):
+            dummy = jax.tree_util.tree_unflatten(
+                treedef, list(range(treedef.num_leaves))
+            )
+            return [jax.tree_util.keystr(p) for p, _ in
+                    jax.tree_util.tree_flatten_with_path(dummy)[0]]
+
+        try:
+            s_paths, t_paths = leaf_paths(s_def), leaf_paths(t_def)
+            divergence = next(
+                (f"first divergence at leaf {i}: sender {a!r} vs "
+                 f"template {b!r}"
+                 for i, (a, b) in enumerate(zip(s_paths, t_paths)) if a != b),
+                f"trees agree on the first {min(len(s_paths), len(t_paths))}"
+                f" leaves but have {len(s_paths)} vs {len(t_paths)}",
+            )
+        except Exception:  # noqa: BLE001 - diagnostics must not mask the guard
+            divergence = f"sender {str(s_def)[:200]} vs template {str(t_def)[:200]}"
         logger.warning(
             "sender tree structure differs from the template's — "
             "index-aligned in-place placement would risk landing leaves "
             "in the wrong buffers; in-place receive degraded to wire "
-            "buffers for this transfer (sender %.200s vs template %.200s)",
-            s_def, t_def,
+            "buffers for this transfer (%s)",
+            divergence,
         )
         return None
     return t_leaves

@@ -75,18 +75,19 @@ def run(
     transport: str = "http",
 ) -> dict:
     """``transport``: "http" (default), "pg" (heal over a dedicated
-    recovery ProcessGroupHost via PGTransport), or "pg-inplace" (adds a
-    preallocated template so received leaves land in place)."""
+    recovery ProcessGroupHost via PGTransport), or "pg-inplace" /
+    "http-inplace" (the Manager-derived template so received leaves land
+    in place)."""
     from torchft_tpu.checkpointing import HTTPTransport, PGTransport
     from torchft_tpu.coordination import LighthouseServer
     from torchft_tpu.manager import Manager
     from torchft_tpu.process_group import ProcessGroupHost as _RecoveryPG
 
-    if transport not in ("http", "pg", "pg-inplace"):
+    if transport not in ("http", "http-inplace", "pg", "pg-inplace"):
         # argparse guards only the CLI; programmatic callers (bench.py's
         # child scripts) must not get a silently mislabeled record
         raise ValueError(f"unknown transport {transport!r}: "
-                         "expected http | pg | pg-inplace")
+                         "expected http | http-inplace | pg | pg-inplace")
 
     if plane == "device":
         import jax
@@ -136,20 +137,21 @@ def run(
             healed = [False]
 
             recovery_pg = None
-            if transport.startswith("pg"):
-                template_fn = None
-                if transport == "pg-inplace":
-                    # the Manager's own live composite (late-bound:
-                    # `manager` is assigned below) — leaf alignment with
-                    # the sender by construction
-                    def template_fn():
-                        return manager.state_dict_template()
+            template_fn = None
+            if transport.endswith("-inplace"):
+                # the Manager's own live composite (late-bound: `manager`
+                # is assigned below) — leaf alignment with the sender by
+                # construction
+                def template_fn():
+                    return manager.state_dict_template()
 
+            if transport.startswith("pg"):
                 recovery_pg = _RecoveryPG(timeout=30.0)
                 tx = PGTransport(recovery_pg, timeout=30.0,
                                  state_dict_template=template_fn)
             else:
-                tx = HTTPTransport(timeout=30.0)
+                tx = HTTPTransport(timeout=30.0,
+                                   state_dict_template=template_fn)
             if attempts == 2:
                 # the rejoiner's heal transfer, isolated from quorum time
                 inner_recv = tx.recv_checkpoint
@@ -281,7 +283,8 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--kill-at", type=int, default=10)
     p.add_argument("--plane", choices=["host", "device"], default="host")
-    p.add_argument("--transport", choices=["http", "pg", "pg-inplace"],
+    p.add_argument("--transport",
+                   choices=["http", "http-inplace", "pg", "pg-inplace"],
                    default="http")
     p.add_argument("--collective-timeout", type=float, default=5.0)
     args = p.parse_args()

@@ -1,7 +1,8 @@
 """Smoke the recovery-bench harness (benchmarks/recovery_bench.py) — the
-machinery behind bench.py's ft_* artifact fields. The http path runs in
-every driver bench; the PG-transport variants only run here, so a
-regression in them must fail CI, not the round artifact."""
+machinery behind bench.py's ft_* artifact fields. The plain http path
+runs in every driver bench; the PG-transport and in-place-template
+variants only run here, so a regression in them must fail CI, not the
+round artifact."""
 
 import os
 import subprocess
@@ -14,8 +15,8 @@ pytestmark = pytest.mark.slow  # spawns a two-replica fleet per case
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("transport", ["pg", "pg-inplace"])
-def test_recovery_bench_pg_transports(transport):
+@pytest.mark.parametrize("transport", ["pg", "pg-inplace", "http-inplace"])
+def test_recovery_bench_heal_transport_variants(transport):
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", "recovery_bench.py"),
          "--size-mb", "8", "--steps", "12", "--kill-at", "4",
@@ -30,7 +31,7 @@ def test_recovery_bench_pg_transports(transport):
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["transport"] == transport
     # the kill happened, the survivor recovered, and the rejoiner healed
-    # over the PG transport (heal_recv timed means recv_checkpoint ran)
+    # over the selected transport (heal_recv timed means recv_checkpoint ran)
     assert rec["recovery_s"] > 0
     assert rec["rejoin_s"] and rec["rejoin_s"] > 0
     assert rec["heal_recv_s"] and rec["heal_recv_s"] > 0

@@ -1,0 +1,53 @@
+"""Run the transport bench's --check regression guard in CI (slow tier).
+
+The streaming/in-place RSS properties are design claims verified at 12 GB
+in docs/performance.md; this exercises the same guard at a CI-friendly
+payload so a streaming path regressing to full materialization (or an
+in-place path regressing to wire buffers) fails the suite, not just a
+manual bench run. 256 MB = 4 x 64 MB leaves: small enough for CI, large
+enough that the leaf-granular in-place bound (3 leaves = 0.75x, one leaf
+of noise headroom over the ~2-leaf legitimate transient) stays tighter
+than the materialization it guards against (1x+).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow  # two processes moving 256 MB per case
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize(
+    "args",
+    [
+        ["--transport", "http"],
+        ["--transport", "http", "--inplace"],
+        ["--transport", "pg"],
+        ["--transport", "pg", "--inplace"],
+    ],
+    ids=["http", "http-inplace", "pg", "pg-inplace"],
+)
+def test_two_process_rss_guard(args):
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "transport_bench.py"),
+         # bench-internal timeout WELL below this test's subprocess kill:
+         # a wedged transport must be reaped by the bench's own handling
+         # (which kills the recv child and reports diagnostics), not by a
+         # SIGKILL here that would orphan the grandchild
+         "--size-mb", "256", "--two-process", "--check",
+         "--timeout", "120", *args],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=REPO,
+    )
+    assert out.returncode == 0, (out.stderr or out.stdout)[-2000:]
+    import json
+
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["size_mb"] == 256
+    assert rec["seconds"] > 0

@@ -469,12 +469,17 @@ def main() -> None:
             # leaf-dominated and the check loses discriminating power.
             leaf_x_payload = 3 * float(CHUNK_MB) / max(args.size_mb, 1)
 
+            # one leaf of slack for EVERY bound: at small payloads a single
+            # transient 64 MB buffer coinciding with the peak is legitimate
+            # noise, not a regression (at 12 GB the slack is ~0.005x)
+            one_leaf = float(CHUNK_MB) / max(args.size_mb, 1)
+
             def bound_for(key: str) -> float:
                 # gate on the stat the run actually produced, not the raw
                 # flag (both http and pg two-process runs report it)
                 if stats.get("inplace") and key == "receiver_rss_x_payload":
                     return max(args.inplace_recv_bound, leaf_x_payload)
-                return args.rss_bound
+                return args.rss_bound + one_leaf
 
             over = {
                 k: (v, bound_for(k)) for k, v in stats.items()

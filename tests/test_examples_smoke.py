@@ -26,7 +26,8 @@ pytestmark = pytest.mark.slow  # end-to-end example subprocesses
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_demo(args: "list[str]", timeout: int) -> None:
+def _run_demo(args: "list[str]", timeout: int,
+              success_marker: str = "demo finished rc= 0") -> str:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # own session: the demo driver spawns a lighthouse + replica
     # grandchildren; on a wedge the whole process GROUP must die, not just
@@ -60,7 +61,8 @@ def _run_demo(args: "list[str]", timeout: int) -> None:
         f"--- stdout ---\n{stdout[-4000:]}\n"
         f"--- stderr ---\n{stderr[-4000:]}"
     )
-    assert "demo finished rc= 0" in stdout
+    assert success_marker in stdout, stdout[-2000:]
+    return stdout
 
 
 @pytest.mark.slow
@@ -97,3 +99,20 @@ def test_train_diloco_demo():
          "--batch-size", "4", "--sync-every", "2"],
         timeout=420,
     )
+
+
+@pytest.mark.slow
+def test_orchestrator_demo():
+    """Actor-style orchestration (reference: examples/monarch): supervised
+    replica subprocesses, an injected kill via the lighthouse endpoint,
+    and a per-replica restart summary."""
+    import re
+
+    stdout = _run_demo(
+        ["examples/orchestrator.py", "--replicas", "2",
+         "--steps", "25", "--inject-kill-after", "10"],
+        timeout=420,
+        success_marker="succeeded after",
+    )
+    # the injected kill must have caused at least one supervised restart
+    assert re.search(r"after [1-9] restart", stdout), stdout[-2000:]

@@ -110,9 +110,14 @@ def test_orchestrator_demo():
 
     stdout = _run_demo(
         ["examples/orchestrator.py", "--replicas", "2",
-         "--steps", "25", "--inject-kill-after", "10"],
+         "--steps", "60", "--inject-kill-after", "8"],
         timeout=420,
         success_marker="succeeded after",
     )
-    # the injected kill must have caused at least one supervised restart
-    assert re.search(r"after [1-9] restart", stdout), stdout[-2000:]
+    # assert on kill EVIDENCE, not wall-clock: the injection must have hit
+    # a live worker and the supervisor must have respawned it (a fast host
+    # finishing training before the injection would otherwise flake a
+    # restart-count assertion)
+    assert "[chaos] killed" in stdout, stdout[-2000:]
+    assert ("worker died rc=" in stdout
+            or re.search(r"after [1-9] restart", stdout)), stdout[-2000:]

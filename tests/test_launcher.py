@@ -103,10 +103,11 @@ def test_doctor_cli():
         for line in proc.stdout.splitlines()
         if line.startswith(("ok", "warn", "FAIL"))
     }
-    assert set(lines) == {"native", "accelerator", "virtual-mesh", "lighthouse"}, (
+    assert set(lines) == {"native", "accelerator", "virtual-mesh",
+                          "lighthouse", "heal"}, (
         proc.stdout + proc.stderr
     )
-    for check in ("native", "virtual-mesh", "lighthouse"):
+    for check in ("native", "virtual-mesh", "lighthouse", "heal"):
         assert lines[check] == "ok", proc.stdout
     if lines["accelerator"] != "FAIL":
         assert proc.returncode == 0, proc.stdout

@@ -6,7 +6,9 @@ group right now": native control plane builds and serves, JAX backend
 initializes (with a subprocess probe so a wedged TPU tunnel reports as
 WEDGED instead of hanging the doctor — the failure mode bench.py's
 `_probe_accelerator` exists for), the virtual multi-device CPU mesh works
-(what tests and dryruns rely on), and a lighthouse round-trip completes.
+(what tests and dryruns rely on), a lighthouse round-trip completes, and
+a loopback live-heal round-trip through the default HTTP transport lands
+in place (the tier-1 recovery path a rejoining replica depends on).
 
 Exit code 0 iff every check passes (the accelerator check passes as
 "cpu-only" — a legitimate dev box). Prints one line per check:
@@ -103,11 +105,47 @@ def check_lighthouse_roundtrip() -> Result:
         return False, f"lighthouse round-trip failed: {e}"
 
 
+def check_heal_roundtrip() -> Result:
+    """Loopback live-heal: send a small composite through the default
+    HTTPTransport and receive it in place — the tier-1 recovery path a
+    rejoining replica depends on."""
+    try:
+        import numpy as np
+
+        from torchft_tpu.checkpointing import HTTPTransport
+
+        state = {"user": {"w": np.arange(256, dtype=np.float32)},
+                 "torchft": {"step": 3, "batches_committed": 6}}
+        template = {"user": {"w": np.zeros(256, np.float32)},
+                    "torchft": {"step": 0, "batches_committed": 0}}
+        # pin loopback: gethostname() can be locally unresolvable on
+        # minimal containers (the fleet problem `hostname` exists for),
+        # and this check diagnoses the transport, not DNS
+        send = HTTPTransport(timeout=10.0, num_chunks=2,
+                             hostname="127.0.0.1")
+        recv = HTTPTransport(timeout=10.0,
+                             state_dict_template=lambda: template)
+        try:
+            send.send_checkpoint([1], 3, state, 10.0)
+            got = recv.recv_checkpoint(0, send.metadata(), 3, 10.0)
+        finally:
+            send.shutdown()
+            recv.shutdown()
+        if got["user"]["w"] is not template["user"]["w"]:
+            return False, "heal received but not in place (template unused)"
+        if not np.array_equal(got["user"]["w"], state["user"]["w"]):
+            return False, "heal payload mismatch"
+        return True, "http heal round-trip in place (1 KiB composite)"
+    except Exception as e:  # noqa: BLE001
+        return False, f"heal round-trip failed: {e}"
+
+
 CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("native", check_native),
     ("accelerator", check_accelerator),
     ("virtual-mesh", check_virtual_mesh),
     ("lighthouse", check_lighthouse_roundtrip),
+    ("heal", check_heal_roundtrip),
 ]
 
 

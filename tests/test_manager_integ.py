@@ -39,8 +39,8 @@ class Runner:
     total_steps: int = NUM_STEPS
     # "http" (default) or "pg" — heal over a dedicated recovery
     # ProcessGroupHost via PGTransport, kept in quorum lockstep by the
-    # Manager's transport-configure hook; "pg-inplace" adds a state-dict
-    # template so received leaves land in preallocated buffers
+    # Manager's transport-configure hook; "pg-inplace"/"http-inplace" add
+    # the Manager-derived template so received leaves land in place
     transport: str = "http"
     # fail this replica's transport.configure N times (transient recovery-
     # store fault): recovery must come from the commit-failure quorum bump
@@ -69,7 +69,14 @@ class Runner:
 
         pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
         transport = None
-        if self.transport.startswith("pg"):
+        if self.transport == "http-inplace":
+            from torchft_tpu.checkpointing import HTTPTransport
+
+            transport = HTTPTransport(
+                timeout=10.0,
+                state_dict_template=lambda: manager.state_dict_template(),
+            )
+        elif self.transport.startswith("pg"):
             from torchft_tpu.checkpointing import PGTransport
             from torchft_tpu.process_group import ProcessGroupBabyHost
 
@@ -128,7 +135,7 @@ class Runner:
                     "batches": manager.batches_committed()}
         finally:
             manager.shutdown(wait=False)
-            if transport is not None:
+            if transport is not None and hasattr(transport, "_pg"):
                 transport._pg.shutdown()  # the recovery PG is caller-owned
 
 
@@ -187,6 +194,29 @@ class TestRecovery:
         assert injector.count == 1
         assert_params_equal(results)
         assert all(r["steps"] == NUM_STEPS for r in results)
+
+    def test_crash_and_rejoin_heals_over_http_inplace(self, lighthouse, caplog):
+        """The DEFAULT transport with the Manager-derived template: the
+        heal streams off the socket into the template's buffers. Zero
+        degraded-path records from the transport is the in-place evidence
+        — a template misalignment or absorb failure would log per-receive
+        fallbacks and this test would still converge but fail here."""
+        injector = EventInjector().fail_at(replica=1, step=2)
+        addr = f"127.0.0.1:{lighthouse.port}"
+        with caplog.at_level(
+            "WARNING", logger="torchft_tpu.checkpointing.http_transport"
+        ):
+            results = run_replicas(
+                [Runner(i, addr, injector, min_replica_size=1,
+                        transport="http-inplace")
+                 for i in range(2)]
+            )
+        assert injector.count == 1
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+        degraded = [r for r in caplog.records
+                    if r.name == "torchft_tpu.checkpointing.http_transport"]
+        assert not degraded, [r.message for r in degraded]
 
     def test_allreduce_failure_discards_step(self, lighthouse):
         injector = EventInjector().fail_allreduce_at(replica=0, step=1)

@@ -292,6 +292,115 @@ class TestInplaceDegradedPaths:
         assert out["w"] is template["w"]  # landed IN the template buffer
         np.testing.assert_array_equal(out["w"], state["w"])
 
+    def test_large_leaf_streams_directly_into_template(self):
+        """Leaves above the raw-frame threshold (64 KiB) take the
+        recv_into fast path: the wire frame lands in the template's own
+        memory. The fallback (recv + copyto) would produce identical
+        outputs, so the fast path is pinned by SPYING on recv_into —
+        identity alone can't detect its regression."""
+        from torchft_tpu.checkpointing.pg_transport import PGTransport
+        from torchft_tpu.coordination import KvStoreServer
+        from torchft_tpu.process_group import ProcessGroupHost
+
+        n = 64 * 1024  # 256 KiB of f32: raw-frame path on the host PG
+        state = {"w": np.arange(n, dtype=np.float32)}
+        template = {"user": {"w": np.zeros(n, dtype=np.float32)}}
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=10.0) for _ in range(2)]
+        absorbed = []
+        real_recv_into = pgs[1].recv_into
+
+        def spy_recv_into(buffers, src, tag=0):
+            work = real_recv_into(buffers, src, tag)
+            fut = work.get_future()
+            orig_wait = fut.wait
+
+            def wait(timeout=None):
+                got = orig_wait(timeout)
+                absorbed.append(
+                    bool(buffers) and got and got[0] is buffers[0]
+                )
+                return got
+
+            fut.wait = wait
+
+            class W:
+                def get_future(self):
+                    return fut
+
+            return W()
+
+        pgs[1].recv_into = spy_recv_into
+        try:
+            addr = f"127.0.0.1:{store.port}/inplace-raw"
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(lambda r: pgs[r].configure(addr, r, 2, 41),
+                            range(2)))
+            sender = PGTransport(pgs[0], timeout=10.0)
+            receiver = PGTransport(
+                pgs[1], timeout=10.0, state_dict_template=lambda: template
+            )
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fs = ex.submit(sender.send_checkpoint, [1], 0,
+                               {"user": state}, 10.0)
+                fr = ex.submit(receiver.recv_checkpoint, 0,
+                               "<pg_transport>", 0, 10.0)
+                fs.result(timeout=30)
+                out = fr.result(timeout=30)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()
+        assert out["user"]["w"] is template["user"]["w"]
+        np.testing.assert_array_equal(out["user"]["w"], state["w"])
+        # the big leaf went through recv_into AND was absorbed in place
+        assert any(absorbed), absorbed
+
+    def test_recv_into_identity_contract(self):
+        """ProcessGroupHost.recv_into: a matching buffer IS the returned
+        entry (raw path), a mismatched buffer yields a fresh array, and
+        sub-threshold pickled messages ignore the buffers."""
+        from torchft_tpu.coordination import KvStoreServer
+        from torchft_tpu.process_group import ProcessGroupHost
+
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=10.0) for _ in range(2)]
+        try:
+            addr = f"127.0.0.1:{store.port}/recvinto"
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(lambda r: pgs[r].configure(addr, r, 2, 42),
+                            range(2)))
+            big = np.arange(64 * 1024, dtype=np.float32)  # raw-frame path
+
+            # matching buffer: identity
+            buf = np.zeros_like(big)
+            w = pgs[0].send([big], 1, tag=5)
+            got = pgs[1].recv_into([buf], 0, tag=5).get_future().wait(10)
+            w.wait(10)
+            assert got[0] is buf
+            np.testing.assert_array_equal(buf, big)
+
+            # mismatched dtype: fresh allocation, data still correct
+            wrong = np.zeros(big.shape, np.int32)
+            w = pgs[0].send([big], 1, tag=6)
+            got = pgs[1].recv_into([wrong], 0, tag=6).get_future().wait(10)
+            w.wait(10)
+            assert got[0] is not wrong
+            np.testing.assert_array_equal(got[0], big)
+
+            # small message: pickled path, buffers ignored
+            small = np.arange(4, dtype=np.float32)
+            sbuf = np.zeros(4, np.float32)
+            w = pgs[0].send([small], 1, tag=7)
+            got = pgs[1].recv_into([sbuf], 0, tag=7).get_future().wait(10)
+            w.wait(10)
+            assert got[0] is not sbuf
+            np.testing.assert_array_equal(got[0], small)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()
+
     def test_dtype_mismatch_warns_and_keeps_values_exact(self, caplog):
         state = {"w": np.arange(64, dtype=np.float32)}
         template = {"w": np.zeros(64, dtype=np.int32)}  # same shape, wrong dtype

@@ -22,6 +22,7 @@ import numpy as np
 from torchft_tpu.checkpointing._serialization import (
     TensorMeta,
     TreeSpecPayload,
+    can_absorb,
     flatten_state,
     leaf_from_bytes,
     place_leaf_like,
@@ -146,9 +147,39 @@ class PGTransport(CheckpointTransport[Any]):
                 spec, self._template_fn(), logger
             )
 
+        # direct-into-template receive (feature-detected: beyond the torch
+        # PG surface; Baby PGs fall back to the recv+place path): a host
+        # template leaf that can absorb gets the raw frame streamed into
+        # its own memory — no wire allocation, no copy
+        recv_into = getattr(self._pg, "recv_into", None)
+
         payload_leaves = []
         for i, meta in enumerate(spec.leaves):
-            buf = self._pg.recv(src_rank, tag=2).get_future().wait(timeout_s)
+            target = None
+            if (
+                recv_into is not None
+                and template_leaves is not None
+                and meta.kind == "array"
+                and can_absorb(template_leaves[i], meta.shape, meta.dtype,
+                               require_contiguous=True)
+            ):
+                target = template_leaves[i]
+            if target is not None:
+                # the wire carries the leaf as one flat uint8 frame; hand
+                # recv_into the template's flat view so the frame lands in
+                # the template's buffer (identity of the returned entry is
+                # the absorbed/fallback signal)
+                view = target.reshape(-1).view(np.uint8)
+                got = self._pg.recv_into([view], src_rank, tag=2) \
+                    .get_future().wait(timeout_s)
+                if got and got[0] is view:
+                    payload_leaves.append(target)
+                    continue
+                buf = got  # pickled path or wire/buffer mismatch
+            else:
+                buf = self._pg.recv(src_rank, tag=2).get_future().wait(
+                    timeout_s
+                )
             # pass the received ndarray straight through: leaf_from_bytes's
             # ndarray path re-views it with zero copies (bytes() would cost
             # two extra full-leaf copies)

@@ -908,19 +908,40 @@ class ProcessGroupHost(ProcessGroup):
         return FutureWork(fut)
 
     def recv(self, src, tag=0):
+        return self.recv_into([], src, tag)
+
+    def recv_into(self, buffers, src, tag=0):
+        """Like :meth:`recv` (which delegates here with no buffers), but
+        raw-frame payloads land DIRECTLY in the caller's preallocated
+        ``buffers`` — no wire allocation and no copy (the in-place
+        checkpoint receive's hot path; beyond the torch PG surface, so
+        transports feature-detect it with ``getattr``).
+
+        The returned Work's value is the list of received arrays: entry i
+        IS ``buffers[i]`` when the wire used a raw frame and the buffer
+        can absorb it (the shared ``can_absorb`` predicate, contiguity
+        required); otherwise a freshly allocated array (small pickled
+        messages, mismatched buffers, or more leaves than buffers).
+        """
         def _run(comm):
             kind, got_tag, payload = comm.recv_from(src)
             assert got_tag == tag, (kind, got_tag, tag)
             if kind == "p2p":
-                return payload
+                return payload  # pickled small-message path: no raw frames
             assert kind == "p2p_raw", kind
+            # one absorb predicate across every in-place path (no import
+            # cycle: _serialization depends only on numpy/utils)
+            from torchft_tpu.checkpointing._serialization import can_absorb
             from torchft_tpu.utils import np_dtype_from_str
 
             out = []
-            for dtype_str, shape in payload:
-                arr = np.empty(shape, np_dtype_from_str(dtype_str))
-                comm.recv_raw_into(src, arr)
-                out.append(arr)
+            for i, (dtype_str, shape) in enumerate(payload):
+                target = buffers[i] if i < len(buffers) else None
+                if not can_absorb(target, shape, dtype_str,
+                                  require_contiguous=True):
+                    target = np.empty(shape, np_dtype_from_str(dtype_str))
+                comm.recv_raw_into(src, target)
+                out.append(target)
             return out
 
         return self._submit(_run, "recv", mode="p2p")

@@ -280,12 +280,15 @@ def place_leaf_like(host_leaf: np.ndarray, template: Any,
         import jax
 
         if isinstance(template, jax.Array):
-            if template.dtype == host_leaf.dtype:
+            if (template.dtype == host_leaf.dtype
+                    and template.shape == host_leaf.shape):
                 return jax.device_put(host_leaf, template.sharding)
             # same no-silent-coercion contract as the host path below: an
-            # astype here would round/truncate the sender's values with no
-            # signal (the dtypes can drift when template and sender state
-            # were built from different recipes, e.g. f32-master vs bf16)
+            # astype/reshape here would round, truncate, or reshard the
+            # sender's values with no signal (shape and dtype can drift
+            # when template and sender state were built from different
+            # recipes, e.g. f32-master vs bf16) — fall through to the
+            # degraded-warning path so the mismatch is visible in logs
         if can_absorb(template, host_leaf.shape, host_leaf.dtype):
             np.copyto(template, host_leaf)
             return template

@@ -324,6 +324,15 @@ class HTTPTransport(CheckpointTransport[Any]):
                         return
                     leaf_idx, nbytes = _FRAME.unpack(hdr)
                     meta = spec.leaves[leaf_idx]
+                    if nbytes != meta.nbytes:
+                        # a short frame would exit the read loop cleanly
+                        # and leave the leaf — possibly a live template
+                        # buffer — half-written with no error
+                        raise ConnectionError(
+                            f"chunk {i} leaf {leaf_idx}: frame carries "
+                            f"{nbytes} bytes but the leaf spec says "
+                            f"{meta.nbytes}"
+                        )
                     if meta.kind == "array":
                         target = _host_target(meta, leaf_idx)
                         arr = target if target is not None else alloc_leaf(meta)
@@ -344,7 +353,16 @@ class HTTPTransport(CheckpointTransport[Any]):
                             )
                         payloads[leaf_idx] = arr
                     else:
-                        payloads[leaf_idx] = r.read(nbytes)
+                        body = r.read(nbytes)
+                        if len(body) != nbytes:
+                            # read() returns short at EOF; without this the
+                            # loop exits cleanly and the truncation surfaces
+                            # later as an opaque UnpicklingError
+                            raise ConnectionError(
+                                f"chunk {i} truncated at pickled leaf "
+                                f"{leaf_idx} ({len(body)}/{nbytes} bytes)"
+                            )
+                        payloads[leaf_idx] = body
 
         with ThreadPoolExecutor(max_workers=max(1, min(num_chunks, 8))) as ex:
             list(ex.map(fetch_chunk, range(num_chunks)))

@@ -180,6 +180,15 @@ class PGTransport(CheckpointTransport[Any]):
                 buf = self._pg.recv(src_rank, tag=2).get_future().wait(
                     timeout_s
                 )
+            if not buf:
+                # an aborted/errored receive resolves to an empty result;
+                # indexing it would mask the transport failure with an
+                # IndexError
+                err = self._pg.errored()
+                raise RuntimeError(
+                    f"recv of leaf {i} from rank {src_rank} returned no "
+                    f"buffer (pg errored: {err})"
+                )
             # pass the received ndarray straight through: leaf_from_bytes's
             # ndarray path re-views it with zero copies (bytes() would cost
             # two extra full-leaf copies)

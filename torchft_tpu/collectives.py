@@ -7,15 +7,21 @@ SUM and AVG only. Cuts the replicated-dim wire traffic ~4x vs f32 — on a
 TPU fleet this is DCN bandwidth between replica groups, usually the
 scarcest link.
 
-Two quantization engines behind one wire format (uint8 fp8 payload + f32
+Three quantization engines behind one wire format (uint8 fp8 payload + f32
 row scales + element count):
 
-- **device (Pallas)**: when every input leaf is a ``jax.Array``, the
-  quantize / dequantize+reduce / requantize stages run as the fused Pallas
-  kernels (ops/quantization.py) on the accelerator — the production path,
-  matching the reference's Triton kernels (torchft/quantization.py:531-686
-  called from collectives.py:297-415). Only the ~1 byte/element compressed
-  payload crosses to the host for the wire, so D2H traffic drops ~4x too.
+- **device (Pallas)**: single-device ``jax.Array`` trees run the
+  quantize / dequantize+reduce / requantize stages as the fused Pallas
+  kernels (ops/quantization.py) on the accelerator — matching the
+  reference's Triton kernels (torchft/quantization.py:531-686 called from
+  collectives.py:297-415). Only the ~1 byte/element compressed payload
+  crosses to the host for the wire, so D2H traffic drops ~4x too.
+- **SPMD (shard_map + Pallas)**: mesh-sharded leaves (fsdp-sharded DiLoCo
+  pseudogradients) quantize shard-locally — the Pallas kernel is
+  shard_map'ed over each leaf's own mesh, so the full f32 buffer never
+  leaves its sharding; the reduced result lands back on the same
+  mesh/spec. A layout signature rides the wire so ranks with divergent
+  shardings fail loudly instead of reducing misaligned chunks.
 - **host (numpy)**: fallback for numpy inputs (and any mixed pytree).
 
 The pipeline runs on a worker thread (reference `_QuantizedOpFuture`,
@@ -48,21 +54,22 @@ def _ceil_div(a: int, b: int) -> int:
 
 
 def is_device_tree(arrays: Sequence[Any]) -> bool:
-    """True iff every leaf is a single-device jax.Array.
+    """True iff every leaf is a jax.Array (any sharding).
 
-    Mesh-sharded leaves (NamedSharding over >1 device — e.g. fsdp-sharded
-    DiLoCo pseudogradients) must take the host path: the eager Pallas
-    quantize calls have no SPMD partitioning rule, so running them on a
-    sharded array would either fail to lower or force a full gather onto
-    one device. The host path's np.asarray performs the same gather but
-    into host RAM, where the wire needs the bytes anyway.
+    Single-device trees run the fused Pallas engine on the global flat
+    buffer. Mesh-sharded leaves (NamedSharding over >1 device — e.g.
+    fsdp-sharded DiLoCo pseudogradients) run the SPMD engine: the Pallas
+    quantize kernel is shard_map'ed over each leaf's own mesh, so every
+    device compresses its local shard in place and only the ~1
+    byte/element fp8 payload ever crosses D2H (the reference keeps its
+    fp8 pipeline on-accelerator the same way,
+    torchft/quantization.py:531-686 via collectives.py:297-415). Leaves
+    whose sharded dims don't divide evenly fall back to the host engine
+    at call time (shard_map needs even shards).
     """
     import jax
 
-    return bool(arrays) and all(
-        isinstance(a, jax.Array) and len(a.sharding.device_set) == 1
-        for a in arrays
-    )
+    return bool(arrays) and all(isinstance(a, jax.Array) for a in arrays)
 
 
 def _flatten(arrays: Sequence[Any]) -> tuple[np.ndarray, List[tuple], List[np.dtype]]:
@@ -196,6 +203,242 @@ def _allreduce_quantized_device(flat, shapes, dtypes, op, pg, row):
     return _unflatten_jax(out, shapes, dtypes)
 
 
+# ---------------------------------------------------------------------------
+# SPMD engine: mesh-sharded leaves quantize shard-locally via shard_map
+# ---------------------------------------------------------------------------
+class _UnevenSharding(Exception):
+    """Leaf's sharded dims don't divide evenly; caller falls back to host."""
+
+
+def _sharded_axes(spec) -> tuple:
+    """Flatten a PartitionSpec into the ordered tuple of mesh axis names it
+    shards over (the rows-layout order of the wire)."""
+    axes: List[Any] = []
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, tuple):
+            axes.extend(part)
+        else:
+            axes.append(part)
+    return tuple(axes)
+
+
+def _leaf_plan(a, row: int):
+    """Per-leaf wire plan: how this leaf's rows lay out on the wire.
+
+    kind "sharded": quantized shard-locally (mesh-order row stacking);
+    kind "single": quantized on the leaf's one device (or replicated).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    sh = a.sharding
+    if isinstance(sh, NamedSharding):
+        axes = _sharded_axes(sh.spec)
+        n_shards = 1
+        for ax in axes:
+            n_shards *= sh.mesh.shape[ax]
+        if n_shards > 1:
+            try:
+                # shard_shape raises when a sharded dim doesn't divide
+                # evenly — exactly the shapes shard_map can't handle
+                local_shape = sh.shard_shape(a.shape)
+            except ValueError as e:
+                raise _UnevenSharding(str(e)) from None
+            local_n = 1
+            for s in local_shape:
+                local_n *= s
+            local_rows = max(1, _ceil_div(local_n, row))
+            return {
+                "kind": "sharded",
+                "sharding": sh,
+                "axes": axes,
+                "local_shape": local_shape,
+                "local_n": local_n,
+                "rows": local_rows * n_shards,
+                "shape": a.shape,
+                "dtype": a.dtype,
+            }
+    n = int(a.size)
+    return {
+        "kind": "single",
+        "sharding": sh,
+        "n": n,
+        "rows": max(1, _ceil_div(n, row)),
+        "shape": a.shape,
+        "dtype": a.dtype,
+    }
+
+
+def _quantize_leaf(a, plan, row: int):
+    """Quantize one leaf per its plan; returns host (uint8 rows, f32 scales).
+
+    Sharded leaves never materialize off their mesh: shard_map runs the
+    Pallas quantize kernel on each device's own shard, and the only D2H is
+    np.asarray on the fp8 output."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if plan["kind"] == "sharded":
+        sh = plan["sharding"]
+        axes = plan["axes"]
+
+        def local(x):
+            q, s, _ = fused_quantize_fp8(x.reshape(-1), row)
+            return q, s
+
+        q, s = shard_map(
+            local,
+            mesh=sh.mesh,
+            in_specs=(sh.spec,),
+            out_specs=(P(axes, None), P(axes, None)),
+            check_vma=False,
+        )(a)
+    else:
+        q, s, _ = fused_quantize_fp8(a.reshape(-1), row)
+    return np.asarray(q).view(np.uint8), np.asarray(s).reshape(-1)
+
+
+def _reconstruct_leaf(q_rows: np.ndarray, scales: np.ndarray, plan, row: int):
+    """Inverse of _quantize_leaf: land the reduced fp8 rows back on the
+    leaf's own mesh (sharded H2D of compressed bytes, then a shard-local
+    Pallas dequantize into the original spec)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.ops.quantization import _FP8
+
+    if plan["kind"] == "sharded":
+        sh = plan["sharding"]
+        axes = plan["axes"]
+        rows_sharding = NamedSharding(sh.mesh, P(axes, None))
+        dq = jax.device_put(q_rows.view(_FP8), rows_sharding)
+        ds = jax.device_put(
+            scales.reshape(-1, 1).astype(np.float32), rows_sharding
+        )
+        local_n, local_shape, dtype = (
+            plan["local_n"], plan["local_shape"], plan["dtype"],
+        )
+
+        def local(qv, sv):
+            flat = fused_dequantize_fp8(qv, sv, local_n, row)
+            return flat.reshape(local_shape).astype(dtype)
+
+        return shard_map(
+            local,
+            mesh=sh.mesh,
+            in_specs=(P(axes, None), P(axes, None)),
+            out_specs=sh.spec,
+            check_vma=False,
+        )(dq, ds)
+
+    import jax.numpy as jnp
+
+    flat = fused_dequantize_fp8(
+        jnp.asarray(q_rows.view(_FP8)),
+        jnp.asarray(scales.reshape(-1, 1).astype(np.float32)),
+        plan["n"],
+        row,
+    )
+    out = flat.reshape(plan["shape"]).astype(plan["dtype"])
+    return jax.device_put(out, plan["sharding"])
+
+
+def _allreduce_quantized_sharded(arrays, op: ReduceOp, pg: ProcessGroup,
+                                 row: int, plans=None):
+    """SPMD fp8 allreduce for trees with mesh-sharded leaves.
+
+    Wire layout: per-leaf row blocks, each leaf's rows stacked in its
+    mesh-iteration shard order. Every rank must hold identically-sharded
+    leaves (the SPMD contract — same program, same meshes); the layout
+    signature rides the wire so a divergent peer fails loudly instead of
+    reducing misaligned chunks."""
+    import zlib
+
+    world = pg.size()
+    if plans is None:
+        plans = [_leaf_plan(a, row) for a in arrays]
+    parts = [_quantize_leaf(a, p, row) for a, p in zip(arrays, plans)]
+    Q = np.concatenate([q for q, _ in parts], axis=0)  # (total_rows, row) u8
+    S = np.concatenate([s for _, s in parts])  # (total_rows,)
+    total_rows = Q.shape[0]
+    # The signature must pin the full element ordering, not just the row
+    # counts: two shardings of the same leaf (e.g. P(('fsdp','tp'), None)
+    # vs P('fsdp','tp') on a 2x2 mesh) produce identical row counts but
+    # different shard-local flattening orders — equal-rows collisions
+    # would reduce misaligned elements silently.
+    sig = zlib.crc32(
+        repr((row, world, [
+            (p["kind"], p.get("axes"), tuple(p["shape"]),
+             p.get("local_shape"), str(p["dtype"]), p["rows"])
+            for p in plans
+        ])).encode()
+    )
+
+    chunk_rows = _ceil_div(total_rows, world)
+    pad_rows = chunk_rows * world - total_rows
+    if pad_rows:
+        Q = np.concatenate([Q, np.zeros((pad_rows, row), np.uint8)], axis=0)
+        S = np.concatenate([S, np.ones(pad_rows, np.float32)])
+    chunk = chunk_rows * row
+
+    sends = [
+        (Q[r * chunk_rows:(r + 1) * chunk_rows],
+         S[r * chunk_rows:(r + 1) * chunk_rows], chunk, sig)
+        for r in range(world)
+    ]
+    recvd = list(pg.alltoall(sends).get_future().wait())
+    for t in recvd:
+        if len(t) != 4 or t[3] != sig:
+            raise RuntimeError(
+                "quantized-allreduce wire layout mismatch: a peer sent "
+                f"signature {t[3] if len(t) == 4 else '<legacy 3-tuple>'} "
+                f"vs local {sig} — ranks must hold identically-sharded "
+                "leaves (same meshes, specs, and leaf order)"
+            )
+
+    # chunk-sized stages run on the default device via the fused kernels
+    # (a chunk is 1/world of the compressed buffer — small next to the
+    # sharded full buffer the SPMD stages above keep distributed)
+    deq = _device_from_wire([t[:3] for t in recvd], row)  # (world, chunk)
+    acc = deq.sum(axis=0)
+    if op == ReduceOp.AVG:
+        acc = acc / world
+    q2, s2, _ = fused_quantize_fp8(acc, row)
+    gathered = pg.allgather([
+        (np.asarray(q2).view(np.uint8), np.asarray(s2).reshape(-1), chunk,
+         sig)
+    ]).get_future().wait()
+    for g in gathered:
+        if len(g[0]) != 4 or g[0][3] != sig:
+            raise RuntimeError(
+                "quantized-allreduce wire layout mismatch in allgather"
+            )
+
+    Qr = np.concatenate(
+        [np.asarray(g[0][0]).view(np.uint8) for g in gathered], axis=0
+    )[:total_rows]
+    Sr = np.concatenate(
+        [np.asarray(g[0][1]).reshape(-1) for g in gathered]
+    )[:total_rows]
+
+    out, off = [], 0
+    for plan in plans:
+        rows_l = plan["rows"]
+        out.append(
+            _reconstruct_leaf(Qr[off:off + rows_l], Sr[off:off + rows_l],
+                              plan, row)
+        )
+        off += rows_l
+    return out
+
+
+def _has_multidevice_leaf(arrays: Sequence[Any]) -> bool:
+    return any(len(a.sharding.device_set) > 1 for a in arrays)
+
+
 def _reduce_scatter_core(
     flat: np.ndarray, op: ReduceOp, pg: ProcessGroup, row: int
 ) -> tuple[np.ndarray, int]:
@@ -231,40 +474,81 @@ def allreduce_quantized(
         raise ValueError(f"allreduce_quantized supports SUM/AVG, got {op}")
 
     if is_device_tree(arrays):
-        dflat, dshapes, ddtypes = _flatten_jax(arrays)
+        if _has_multidevice_leaf(arrays):
+            try:
+                plans = [_leaf_plan(a, row) for a in arrays]
+            except _UnevenSharding:
+                plans = None  # host fallback below
+            if plans is not None:
+                leaves = list(arrays)
 
-        def run_device() -> List[Any]:
-            if pg.size() <= 1:
-                return _unflatten_jax(dflat, dshapes, ddtypes)
-            return _allreduce_quantized_device(
-                dflat, dshapes, ddtypes, op, pg, row
-            )
+                def run_sharded() -> List[Any]:
+                    if pg.size() <= 1:
+                        return leaves
+                    return _allreduce_quantized_sharded(
+                        leaves, op, pg, row, plans
+                    )
 
-        return _run_async(run_device)
+                return _run_async(run_sharded)
+            # uneven shards: run the host engine but keep the return-type
+            # contract — results land back on each input leaf's sharding
+            # so callers never see the engine choice
+            shardings = [a.sharding for a in arrays]
+            hflat, hshapes, hdtypes = _flatten(arrays)
+
+            def run_host_restore() -> List[Any]:
+                import jax
+
+                world = pg.size()
+                if world <= 1:
+                    outs = _unflatten(hflat, hshapes, hdtypes)
+                else:
+                    outs = _host_allreduce_pipeline(
+                        hflat, hshapes, hdtypes, op, pg, row
+                    )
+                return [
+                    jax.device_put(o, s) for o, s in zip(outs, shardings)
+                ]
+
+            return _run_async(run_host_restore)
+        else:
+            dflat, dshapes, ddtypes = _flatten_jax(arrays)
+
+            def run_device() -> List[Any]:
+                if pg.size() <= 1:
+                    return _unflatten_jax(dflat, dshapes, ddtypes)
+                return _allreduce_quantized_device(
+                    dflat, dshapes, ddtypes, op, pg, row
+                )
+
+            return _run_async(run_device)
 
     flat, shapes, dtypes = _flatten(arrays)
 
     def run() -> List[np.ndarray]:
-        world = pg.size()
-        if world <= 1:
+        if pg.size() <= 1:
             out = flat if op == ReduceOp.SUM else flat.copy()
             return _unflatten(out, shapes, dtypes)
-
-        acc, chunk = _reduce_scatter_core(flat, op, pg, row)
-
-        # requantize the reduced chunk and allgather
-        q, scales, n = quantize_fp8_rowwise(acc, row)
-        gathered = pg.allgather([(q, scales, n)]).get_future().wait()
-
-        out = np.zeros(chunk * world, np.float32)
-        for r in range(world):
-            (qg, sg, ng) = gathered[r][0]
-            out[r * chunk : r * chunk + ng] = dequantize_fp8_rowwise(
-                np.asarray(qg), np.asarray(sg), ng
-            )
-        return _unflatten(out[: flat.size], shapes, dtypes)
+        return _host_allreduce_pipeline(flat, shapes, dtypes, op, pg, row)
 
     return _run_async(run)
+
+
+def _host_allreduce_pipeline(flat, shapes, dtypes, op, pg, row):
+    """Host-engine allreduce body: reduce-scatter, requantize, allgather."""
+    world = pg.size()
+    acc, chunk = _reduce_scatter_core(flat, op, pg, row)
+
+    q, scales, n = quantize_fp8_rowwise(acc, row)
+    gathered = pg.allgather([(q, scales, n)]).get_future().wait()
+
+    out = np.zeros(chunk * world, np.float32)
+    for r in range(world):
+        (qg, sg, ng) = gathered[r][0]
+        out[r * chunk : r * chunk + ng] = dequantize_fp8_rowwise(
+            np.asarray(qg), np.asarray(sg), ng
+        )
+    return _unflatten(out[: flat.size], shapes, dtypes)
 
 
 def reduce_scatter_quantized(

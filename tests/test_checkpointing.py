@@ -152,7 +152,9 @@ class TestHTTPRestageAtomicity:
             src.send_checkpoint([1], step=5, state_dict=state_n, timeout=10.0)
 
             url = urllib.parse.urlparse(src.metadata())
-            s = _socket.create_connection((url.hostname, url.port), timeout=10)
+            # generous timeout: a loaded 1-vCPU host can starve the server
+            # thread for several seconds without anything being wrong
+            s = _socket.create_connection((url.hostname, url.port), timeout=30)
             s.sendall(b"GET /checkpoint/5/chunk_0 HTTP/1.1\r\n"
                       b"Host: x\r\nConnection: close\r\n\r\n")
             # read headers + a small prefix of the body, then pause

@@ -59,7 +59,8 @@ def _is_array(x: Any) -> bool:
     return isinstance(x, np.ndarray) or type(x).__module__.startswith("jax")
 
 
-def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
+def flatten_state(state: Any,
+                  snapshot: bool = True) -> Tuple[TreeSpecPayload, List[Any]]:
     """Flatten a pytree into (spec, per-leaf payloads).
 
     Array leaves (numpy or jax) are staged to host and kept as **arrays**
@@ -68,6 +69,16 @@ def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
     peak host memory stays ~1x the payload instead of the 2-3x that
     pre-serializing every leaf costs (VERDICT round-2 item 6). Non-array
     leaves are pickled bytes.
+
+    ``snapshot=True`` copies numpy leaves so a live tree mutated by the
+    training loop can't tear a checkpoint that is still being served
+    (HTTP's pull window outlives the call). A transport whose send is
+    SYNCHRONOUS — the stream completes before send_checkpoint returns, so
+    nothing can mutate the tree mid-stream under the Manager's
+    paused-at-quorum heal protocol — passes ``snapshot=False`` and streams
+    straight from the caller's memory, saving a full checkpoint copy per
+    heal (the reference PGTransport sends from the live tensors the same
+    way, pg_transport.py:202-233).
     """
     import jax
 
@@ -77,10 +88,13 @@ def flatten_state(state: Any) -> Tuple[TreeSpecPayload, List[Any]]:
     for leaf in leaves:
         if _is_array(leaf):
             if isinstance(leaf, np.ndarray):
-                # snapshot: a live numpy leaf may be mutated in place by the
-                # training loop while the serving window is open — streaming
-                # an alias would tear the checkpoint mid-leaf
-                host = np.array(leaf, copy=True, order="C")
+                if snapshot:
+                    # a live numpy leaf may be mutated in place by the
+                    # training loop while the serving window is open —
+                    # streaming an alias would tear the checkpoint mid-leaf
+                    host = np.array(leaf, copy=True, order="C")
+                else:
+                    host = np.ascontiguousarray(leaf)
             else:
                 # jax.Array: on accelerators np.asarray materializes a
                 # fresh host buffer (one D2H). On the CPU backend it can

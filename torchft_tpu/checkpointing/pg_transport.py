@@ -100,29 +100,44 @@ class PGTransport(CheckpointTransport[Any]):
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout
     ) -> None:
-        spec, payloads = flatten_state(state_dict)
-        header = pickle.dumps((step, spec))
+        # snapshot=False: this send is synchronous (the wire completes
+        # before we return), so we stream straight from the caller's
+        # arrays instead of copying the whole checkpoint first
+        spec, payloads = flatten_state(state_dict, snapshot=False)
+        # Batched wire when the PG streams raw frames (direct
+        # ProcessGroupHost — recv_into is the capability marker): ONE send
+        # carries every leaf, i.e. one pickled meta message then raw
+        # back-to-back frames, mirroring the reference's one-pickled-meta +
+        # raw-tensor stream (pg_transport.py:202-305). Per-leaf control
+        # round-trips, Work futures, and window waits all collapse into a
+        # single streamed message. The header tells the receiver which
+        # protocol is on the wire.
+        batched = hasattr(self._pg, "recv_into")
+        header = pickle.dumps((step, spec, batched))
+        wires = [
+            buf.reshape(-1).view(np.uint8)
+            if isinstance(buf, np.ndarray)
+            else np.frombuffer(buf, dtype=np.uint8)
+            for buf in payloads
+        ]
         for dst in dst_ranks:
             self._pg.send([np.frombuffer(header, dtype=np.uint8)], dst, tag=1).wait(
                 self._timeout
             )
-            # Windowed sends: keep at most SEND_WINDOW leaves in flight.
-            # The window is not about caller overlap (leaves ship as
-            # zero-copy uint8 views; a direct ProcessGroupHost serializes
-            # the wire on its one worker regardless) — it is BACKPRESSURE:
-            # with a ProcessGroupBaby recovery PG each in-flight send is a
-            # pickled full-leaf copy buffered in the child process, and an
-            # unbounded issue loop would materialize a checkpoint-sized
-            # pile of copies there (12GB-class state dicts → host OOM
-            # during healing). The reference's per-leaf blocking wait
-            # (pg_transport.py:202-233) is the window=1 special case.
+            if batched:
+                self._pg.send(wires, dst, tag=2).wait(self._timeout)
+                continue
+            # Windowed per-leaf sends: keep at most SEND_WINDOW leaves in
+            # flight. The window is not about caller overlap — it is
+            # BACKPRESSURE: with a ProcessGroupBaby recovery PG each
+            # in-flight send is a pickled full-leaf copy buffered in the
+            # child process, and an unbounded issue loop (or one batched
+            # send) would materialize a checkpoint-sized pile of copies
+            # there (12GB-class state dicts → host OOM during healing).
+            # The reference's per-leaf blocking wait (pg_transport.py:
+            # 202-233) is the window=1 special case.
             pending: List[Any] = []
-            for buf in payloads:
-                wire = (
-                    buf.reshape(-1).view(np.uint8)
-                    if isinstance(buf, np.ndarray)
-                    else np.frombuffer(buf, dtype=np.uint8)
-                )
+            for wire in wires:
                 pending.append(self._pg.send([wire], dst, tag=2))
                 if len(pending) >= self.SEND_WINDOW:
                     pending.pop(0).wait(self._timeout)
@@ -134,7 +149,10 @@ class PGTransport(CheckpointTransport[Any]):
             timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
         )
         header = self._pg.recv(src_rank, tag=1).get_future().wait(timeout_s)
-        got_step, spec = pickle.loads(bytes(header[0]))
+        # tolerant unpack: a pre-batching peer sends (step, spec) — treat
+        # as the per-leaf wire so mixed-version heals still work
+        got_step, spec, *rest = pickle.loads(bytes(header[0]))
+        batched = rest[0] if rest else False
         if got_step != step:
             raise RuntimeError(f"expected checkpoint step {step}, got {got_step}")
 
@@ -153,9 +171,7 @@ class PGTransport(CheckpointTransport[Any]):
         # its own memory — no wire allocation, no copy
         recv_into = getattr(self._pg, "recv_into", None)
 
-        payload_leaves = []
-        for i, meta in enumerate(spec.leaves):
-            target = None
+        def _absorb_target(i: int, meta) -> Optional[np.ndarray]:
             if (
                 recv_into is not None
                 and template_leaves is not None
@@ -163,39 +179,77 @@ class PGTransport(CheckpointTransport[Any]):
                 and can_absorb(template_leaves[i], meta.shape, meta.dtype,
                                require_contiguous=True)
             ):
-                target = template_leaves[i]
-            if target is not None:
-                # the wire carries the leaf as one flat uint8 frame; hand
-                # recv_into the template's flat view so the frame lands in
-                # the template's buffer (identity of the returned entry is
-                # the absorbed/fallback signal)
-                view = target.reshape(-1).view(np.uint8)
-                got = self._pg.recv_into([view], src_rank, tag=2) \
-                    .get_future().wait(timeout_s)
-                if got and got[0] is view:
-                    payload_leaves.append(target)
-                    continue
-                buf = got  # pickled path or wire/buffer mismatch
-            else:
-                buf = self._pg.recv(src_rank, tag=2).get_future().wait(
-                    timeout_s
-                )
-            if not buf:
-                # an aborted/errored receive resolves to an empty result;
-                # indexing it would mask the transport failure with an
-                # IndexError
-                err = self._pg.errored()
-                raise RuntimeError(
-                    f"recv of leaf {i} from rank {src_rank} returned no "
-                    f"buffer (pg errored: {err})"
-                )
+                return template_leaves[i]
+            return None
+
+        def _finish_leaf(i: int, meta, wire_buf) -> Any:
             # pass the received ndarray straight through: leaf_from_bytes's
             # ndarray path re-views it with zero copies (bytes() would cost
             # two extra full-leaf copies)
-            leaf = leaf_from_bytes(meta, buf[0])
+            leaf = leaf_from_bytes(meta, wire_buf)
             if template_leaves is not None and meta.kind == "array":
                 leaf = place_leaf_like(leaf, template_leaves[i], logger)
-            payload_leaves.append(leaf)
+            return leaf
+
+        payload_leaves: List[Any] = []
+        if batched:
+            # one message carries every leaf: match it with ONE receive.
+            # Absorb-capable template leaves ride as preallocated views so
+            # their raw frames stream straight into the template's memory;
+            # the rest land in wire buffers and are placed after.
+            targets = [_absorb_target(i, m) for i, m in enumerate(spec.leaves)]
+            views = [
+                t.reshape(-1).view(np.uint8) if t is not None else None
+                for t in targets
+            ]
+            if recv_into is not None:
+                got = self._pg.recv_into(views, src_rank, tag=2) \
+                    .get_future().wait(timeout_s)
+            else:
+                got = self._pg.recv(src_rank, tag=2).get_future().wait(
+                    timeout_s
+                )
+            if not got or len(got) != len(spec.leaves):
+                err = self._pg.errored()
+                raise RuntimeError(
+                    f"batched recv from rank {src_rank} returned "
+                    f"{0 if not got else len(got)} of {len(spec.leaves)} "
+                    f"leaves (pg errored: {err})"
+                )
+            for i, meta in enumerate(spec.leaves):
+                if views[i] is not None and got[i] is views[i]:
+                    payload_leaves.append(targets[i])
+                else:
+                    payload_leaves.append(_finish_leaf(i, meta, got[i]))
+        else:
+            for i, meta in enumerate(spec.leaves):
+                target = _absorb_target(i, meta)
+                if target is not None:
+                    # the wire carries the leaf as one flat uint8 frame;
+                    # hand recv_into the template's flat view so the frame
+                    # lands in the template's buffer (identity of the
+                    # returned entry is the absorbed/fallback signal)
+                    view = target.reshape(-1).view(np.uint8)
+                    got = self._pg.recv_into([view], src_rank, tag=2) \
+                        .get_future().wait(timeout_s)
+                    if got and got[0] is view:
+                        payload_leaves.append(target)
+                        continue
+                    buf = got  # pickled path or wire/buffer mismatch
+                else:
+                    buf = self._pg.recv(src_rank, tag=2).get_future().wait(
+                        timeout_s
+                    )
+                if not buf:
+                    # an aborted/errored receive resolves to an empty
+                    # result; indexing it would mask the transport failure
+                    # with an IndexError
+                    err = self._pg.errored()
+                    raise RuntimeError(
+                        f"recv of leaf {i} from rank {src_rank} returned no "
+                        f"buffer (pg errored: {err})"
+                    )
+                payload_leaves.append(_finish_leaf(i, meta, buf[0]))
 
         import jax
 

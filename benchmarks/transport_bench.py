@@ -161,7 +161,8 @@ def _add_steady_stats(stats: dict, recv_stats: dict, size_mb: int) -> None:
 
 
 def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool,
-                         repeat: int = 1) -> dict:
+                         repeat: int = 1,
+                         snapshot_send: bool = True) -> dict:
     """Per-side RSS for the PG transport: parent = rank 0 sender, child =
     rank 1 receiver, each its own process over a shared KV store. With
     ``inplace`` the child preallocates a template and receives into it.
@@ -190,7 +191,9 @@ def bench_pg_two_process(size_mb: int, timeout: float, inplace: bool,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
     pg = ProcessGroupHost(timeout=timeout)
-    sender = PGTransport(pg, timeout=timeout)
+    # snapshot_send=False is the zero-copy row: this sender mutates nothing
+    # mid-stream, which is the contract that mode requires
+    sender = PGTransport(pg, timeout=timeout, snapshot_send=snapshot_send)
     try:
         rss_before = _rss_mb()
         pg.configure(addr, 0, 2, quorum_id=1)  # rendezvous with the child
@@ -309,6 +312,10 @@ def bench_http_two_process(size_mb: int, num_chunks: int, timeout: float,
         # child to finish fetching the staged step before the swap, so the
         # child's retry loop only ever spans the restage gap
         for r in range(1, repeat):
+            # dead child: let communicate() surface its stderr now instead
+            # of stalling grace=timeout for each remaining round
+            if child.poll() is not None:
+                break
             # full-timeout grace: the child may still be allocating its
             # template before its first fetch; a short grace would restage
             # early and strand the child's step-r retry loop
@@ -484,6 +491,11 @@ def main() -> None:
                              "so receiver RSS growth must stay ~one leaf; "
                              "the general --rss-bound (~1x) would pass even "
                              "a fully-materializing regression")
+    parser.add_argument("--no-snapshot-send", action="store_true",
+                        help="pg: stream straight from the sender's arrays "
+                             "(PGTransport snapshot_send=False — no "
+                             "per-heal checkpoint copy; requires nothing "
+                             "mutates state mid-stream)")
     parser.add_argument("--repeat", type=int, default=1,
                         help="two-process: heal the same pair N times; "
                              "rounds >1 report the steady state (round 1 "
@@ -522,7 +534,8 @@ def main() -> None:
             )
         else:  # "pg" — argparse choices exclude everything else
             stats = bench_pg_two_process(
-                args.size_mb, args.timeout, args.inplace, args.repeat
+                args.size_mb, args.timeout, args.inplace, args.repeat,
+                snapshot_send=not args.no_snapshot_send,
             )
         if args.check:
             # in-place receive holds ~1-2 transient CHUNK_MB leaves besides

@@ -61,8 +61,19 @@ class PGTransport(CheckpointTransport[Any]):
         pg: ProcessGroup,
         timeout: "float | timedelta" = 60.0,
         state_dict_template: Optional[Callable[[], Any]] = None,
+        snapshot_send: bool = True,
     ) -> None:
+        """``snapshot_send=False`` streams straight from the caller's
+        arrays (no per-heal checkpoint copy). Safe only when nothing
+        mutates registered numpy state while send_checkpoint runs — true
+        under a sync-quorum Manager (the trainer is blocked inside
+        start_quorum during the heal) or when all mutable state is
+        jax.Arrays (immutable buffers; functional updates rebind instead
+        of writing in place). An async-quorum host-plane trainer that
+        mutates numpy state in place (EMA buffers, running stats) must
+        keep the default or a heal can read a torn leaf."""
         self._pg = pg
+        self._snapshot_send = snapshot_send
         self._timeout = (
             timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
         )
@@ -96,24 +107,55 @@ class PGTransport(CheckpointTransport[Any]):
         )
 
     SEND_WINDOW = 4
+    # Batched-wire message cap: bounds how much one tag-2 message can
+    # buffer in a ProcessGroupBaby child (which pickles whole messages
+    # through its pipe) while still amortizing per-message control
+    # round-trips ~leaves-per-group times. Both sides derive the SAME
+    # grouping from the spec, so the protocol needs no extra negotiation.
+    BATCH_GROUP_BYTES = 256 << 20
+
+    @classmethod
+    def _wire_groups(cls, spec) -> List[List[int]]:
+        """Deterministic partition of leaf indices into wire messages:
+        consecutive leaves packed up to BATCH_GROUP_BYTES per message
+        (always at least one leaf). Derived identically by sender and
+        receiver from the spec that rides the header."""
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for i, meta in enumerate(spec.leaves):
+            if cur and cur_bytes + meta.nbytes > cls.BATCH_GROUP_BYTES:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += meta.nbytes
+        if cur:
+            groups.append(cur)
+        return groups
 
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout
     ) -> None:
-        # snapshot=False: this send is synchronous (the wire completes
-        # before we return), so we stream straight from the caller's
-        # arrays instead of copying the whole checkpoint first
-        spec, payloads = flatten_state(state_dict, snapshot=False)
+        # snapshot_send=False streams straight from the caller's arrays
+        # (see __init__); the default copies numpy leaves so a training
+        # loop mutating them mid-stream cannot tear the checkpoint
+        spec, payloads = flatten_state(
+            state_dict, snapshot=self._snapshot_send
+        )
         # Batched wire when the PG streams raw frames (direct
-        # ProcessGroupHost — recv_into is the capability marker): ONE send
-        # carries every leaf, i.e. one pickled meta message then raw
+        # ProcessGroupHost — recv_into is the capability marker): each
+        # message carries a GROUP of leaves (one pickled meta then raw
         # back-to-back frames, mirroring the reference's one-pickled-meta +
-        # raw-tensor stream (pg_transport.py:202-305). Per-leaf control
-        # round-trips, Work futures, and window waits all collapse into a
-        # single streamed message. The header tells the receiver which
-        # protocol is on the wire.
+        # raw-tensor stream, pg_transport.py:202-305), so per-leaf control
+        # round-trips and Work futures amortize across the group while a
+        # Baby peer's per-message buffering stays capped at
+        # BATCH_GROUP_BYTES. The header tells the receiver which protocol
+        # is on the wire; the non-batched header stays a 2-tuple for
+        # pre-batching receivers.
         batched = hasattr(self._pg, "recv_into")
-        header = pickle.dumps((step, spec, batched))
+        header = pickle.dumps(
+            (step, spec, True) if batched else (step, spec)
+        )
         wires = [
             buf.reshape(-1).view(np.uint8)
             if isinstance(buf, np.ndarray)
@@ -125,17 +167,19 @@ class PGTransport(CheckpointTransport[Any]):
                 self._timeout
             )
             if batched:
-                self._pg.send(wires, dst, tag=2).wait(self._timeout)
+                for group in self._wire_groups(spec):
+                    self._pg.send([wires[i] for i in group], dst, tag=2) \
+                        .wait(self._timeout)
                 continue
             # Windowed per-leaf sends: keep at most SEND_WINDOW leaves in
             # flight. The window is not about caller overlap — it is
             # BACKPRESSURE: with a ProcessGroupBaby recovery PG each
             # in-flight send is a pickled full-leaf copy buffered in the
-            # child process, and an unbounded issue loop (or one batched
-            # send) would materialize a checkpoint-sized pile of copies
-            # there (12GB-class state dicts → host OOM during healing).
-            # The reference's per-leaf blocking wait (pg_transport.py:
-            # 202-233) is the window=1 special case.
+            # child process, and an unbounded issue loop would materialize
+            # a checkpoint-sized pile of copies there (12GB-class state
+            # dicts → host OOM during healing). The reference's per-leaf
+            # blocking wait (pg_transport.py:202-233) is the window=1
+            # special case.
             pending: List[Any] = []
             for wire in wires:
                 pending.append(self._pg.send([wire], dst, tag=2))
@@ -193,34 +237,39 @@ class PGTransport(CheckpointTransport[Any]):
 
         payload_leaves: List[Any] = []
         if batched:
-            # one message carries every leaf: match it with ONE receive.
-            # Absorb-capable template leaves ride as preallocated views so
-            # their raw frames stream straight into the template's memory;
-            # the rest land in wire buffers and are placed after.
+            # one message per wire group (same deterministic grouping as
+            # the sender derives from this spec). Absorb-capable template
+            # leaves ride as preallocated views so their raw frames stream
+            # straight into the template's memory; the rest land in wire
+            # buffers and are placed after.
             targets = [_absorb_target(i, m) for i, m in enumerate(spec.leaves)]
             views = [
                 t.reshape(-1).view(np.uint8) if t is not None else None
                 for t in targets
             ]
-            if recv_into is not None:
-                got = self._pg.recv_into(views, src_rank, tag=2) \
-                    .get_future().wait(timeout_s)
-            else:
-                got = self._pg.recv(src_rank, tag=2).get_future().wait(
-                    timeout_s
-                )
-            if not got or len(got) != len(spec.leaves):
-                err = self._pg.errored()
-                raise RuntimeError(
-                    f"batched recv from rank {src_rank} returned "
-                    f"{0 if not got else len(got)} of {len(spec.leaves)} "
-                    f"leaves (pg errored: {err})"
-                )
-            for i, meta in enumerate(spec.leaves):
-                if views[i] is not None and got[i] is views[i]:
-                    payload_leaves.append(targets[i])
+            for group in self._wire_groups(spec):
+                gviews = [views[i] for i in group]
+                if recv_into is not None:
+                    got = self._pg.recv_into(gviews, src_rank, tag=2) \
+                        .get_future().wait(timeout_s)
                 else:
-                    payload_leaves.append(_finish_leaf(i, meta, got[i]))
+                    got = self._pg.recv(src_rank, tag=2).get_future().wait(
+                        timeout_s
+                    )
+                n_got = len(got) if got else 0
+                if n_got != len(group):
+                    err = self._pg.errored()
+                    raise RuntimeError(
+                        f"batched recv from rank {src_rank} returned "
+                        f"{n_got} of {len(group)} leaves (pg errored: "
+                        f"{err})"
+                    )
+                for j, i in enumerate(group):
+                    meta = spec.leaves[i]
+                    if views[i] is not None and got[j] is views[i]:
+                        payload_leaves.append(targets[i])
+                    else:
+                        payload_leaves.append(_finish_leaf(i, meta, got[j]))
         else:
             for i, meta in enumerate(spec.leaves):
                 target = _absorb_target(i, meta)

@@ -157,6 +157,18 @@ class Manager:
         self._pg = pg
         self._min_replica_size = min_replica_size
         self._use_async_quorum = use_async_quorum
+        if use_async_quorum and getattr(pg, "requires_sync_quorum", False):
+            # a PG that rebuilds the jax backend on reconfigure (per-quorum
+            # distributed worlds) cannot run configure concurrently with
+            # the trainer's own jax computations: the main thread and the
+            # quorum thread would race backend init mid-rebuild. Quorum
+            # latency moves onto the critical path, which is the price of
+            # an in-process world swap.
+            logger.info(
+                "pg %s requires sync quorum; overriding use_async_quorum",
+                type(pg).__name__,
+            )
+            self._use_async_quorum = False
         self._timeout = float(os.environ.get(TIMEOUT_SEC_ENV, _to_seconds(timeout)))
         self._quorum_timeout = float(
             os.environ.get(
@@ -268,6 +280,8 @@ class Manager:
         self._last_quorum_healed = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._participating_replica_rank: Optional[int] = None
+        # last seen PG backend generation (see _sync_device_world)
+        self._device_world_epoch = getattr(pg, "device_world_epoch", None)
         self._participating_replica_world_size: int = 0
         self._num_replicas: int = 0
 
@@ -350,6 +364,7 @@ class Manager:
         )
         if not self._use_async_quorum:
             self.wait_quorum()
+            self._sync_device_world()
             if self._healing and self._pending_state_dict is not None:
                 # apply eagerly so the forward pass runs on recovered state
                 self._apply_pending_state_dict()
@@ -362,6 +377,38 @@ class Manager:
         assert self._quorum_future is not None, "must call start_quorum first"
         with trace_span("torchft::manager::wait_quorum"):
             self._quorum_future.result()
+
+    def _sync_device_world(self) -> None:
+        """Re-land registered user state on the live jax backend after the
+        PG rebuilt the device world (ProcessGroupXLA's per-quorum
+        distributed worlds tear down + rejoin `jax.distributed`). Arrays
+        created on the OLD backend stay readable but cannot mix with
+        new-world arrays inside one jitted computation — without this, the
+        first post-reconfigure optimizer update dies with "incompatible
+        devices for jitted computation". Called from the main thread at
+        the should_commit / start_quorum sync points (the same places a
+        pending heal is applied). No-op for PGs without a
+        ``device_world_epoch`` (host PGs, local mode) and when a pending
+        heal is about to overwrite user state anyway."""
+        epoch = getattr(self._pg, "device_world_epoch", None)
+        if epoch is None or epoch == self._device_world_epoch:
+            return
+        self._device_world_epoch = epoch
+        if self._healing and self._pending_state_dict is not None:
+            return  # the heal lands on the live backend and wins
+        if not self._user_state_dicts:
+            return
+        import jax
+
+        self._logger.info(
+            f"device world rebuilt (epoch {epoch}); re-landing user state "
+            "on the live backend"
+        )
+        host = jax.tree_util.tree_map(
+            lambda l: np.asarray(l) if isinstance(l, jax.Array) else l,
+            self.user_state_dict(),
+        )
+        self.load_user_state_dict(host)
 
     @traced("torchft::manager::_async_quorum")
     def _async_quorum(
@@ -548,10 +595,38 @@ class Manager:
         leaves, treedef = jax.tree_util.tree_flatten(values)
 
         def rebuild(host_leaves: List[np.ndarray]) -> Any:
+            import jax.numpy as jnp
+
+            # Staleness check at RESOLVE time: if the input leaf's sharding
+            # references a device client that is no longer the live backend
+            # (ProcessGroupXLA tore down + rejoined its per-quorum
+            # jax.distributed world between the caller computing `values`
+            # and this resolve), a device_put onto it can SUCCEED and
+            # produce an array the next jitted computation rejects as
+            # "incompatible devices". Land such leaves on the live backend
+            # instead — _sync_device_world re-lands the user's own state
+            # the same way at should_commit.
+            try:
+                live_client = getattr(jax.devices()[0], "client", None)
+            except Exception:  # noqa: BLE001
+                live_client = None
+
+            def _is_live(sharding) -> bool:
+                if live_client is None:
+                    return True
+                try:
+                    dev = next(iter(sharding.device_set))
+                    return getattr(dev, "client", None) is live_client
+                except Exception:  # noqa: BLE001
+                    return False
+
             out = []
             for orig, host in zip(leaves, host_leaves):
                 if isinstance(orig, jax.Array):
-                    out.append(jax.device_put(host, orig.sharding))
+                    if _is_live(orig.sharding):
+                        out.append(jax.device_put(host, orig.sharding))
+                    else:
+                        out.append(jnp.asarray(np.asarray(host)))
                 else:
                     out.append(np.asarray(host))
             return jax.tree_util.tree_unflatten(treedef, out)
@@ -892,6 +967,7 @@ class Manager:
         if (err := self._pg.errored()) is not None:
             self.report_error(err)
 
+        self._sync_device_world()
         if self._healing and self._pending_state_dict is not None:
             self._apply_pending_state_dict()
         elif self._healing:

@@ -436,6 +436,26 @@ class ProcessGroupXLA(ProcessGroup):
         self._seq: Dict[str, int] = {}
         self._error: Optional[Exception] = None
         self._dispatch_q: Optional[Any] = None  # distributed-mode op stream
+        self._device_world_epoch = 0
+
+    @property
+    def requires_sync_quorum(self) -> bool:
+        """True when configure may rebuild the jax backend (distributed
+        mode, or auto before it resolves): the Manager must then run
+        quorum+configure synchronously so the trainer's jax computations
+        never race a backend teardown on the quorum thread."""
+        return self._mode != "local"
+
+    @property
+    def device_world_epoch(self) -> int:
+        """Bumped every time this PG rebuilds the jax backend (per-quorum
+        distributed worlds tear down + rejoin; the first distributed join
+        rebuilds a backend that predates the world). Arrays created before
+        a bump stay READABLE (their buffers own a client reference) but
+        cannot mix with new-world arrays inside one jitted computation —
+        the Manager watches this and re-lands registered user state on the
+        live backend at the next main-thread sync point."""
+        return self._device_world_epoch
 
     def _distributed_work(self, fn: Callable[[], Any]) -> Work:
         """Distributed-mode op: dispatch + materialization on one worker
@@ -589,6 +609,28 @@ class ProcessGroupXLA(ProcessGroup):
         _join_distributed_world(coord, rank, world_size, self._timeout)
 
         devices = jax.devices()
+        if any(
+            not any(d.process_index == p for d in devices)
+            for p in range(world_size)
+        ):
+            # The local backend predates the distributed world: a trainer
+            # whose main thread touched jax before its FIRST distributed
+            # configure (computing grads while the async quorum runs) has
+            # a cached single-process backend, so device discovery never
+            # saw the world we just joined. Rebuild it — per-quorum
+            # teardown does the same clear before every REjoin; arrays
+            # created on the old backend stay readable (their buffers own
+            # a client reference) and collectives device_put onto the new
+            # world's mesh.
+            jax.clear_caches()
+            try:
+                import jax.extend
+
+                jax.extend.backend.clear_backends()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("clear_backends failed: %s", e)
+            self._device_world_epoch += 1
+            devices = jax.devices()
         leads = []
         for p in range(world_size):
             pd = [d for d in devices if d.process_index == p]
@@ -632,6 +674,7 @@ class ProcessGroupXLA(ProcessGroup):
             jax.extend.backend.clear_backends()
         except Exception as e:  # noqa: BLE001
             logger.warning("clear_backends failed: %s", e)
+        self._device_world_epoch += 1
 
         state = _dist.global_state
         client, state.client = state.client, None

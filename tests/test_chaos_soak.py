@@ -53,7 +53,8 @@ def test_random_kills_converge_bitwise(transport_kind):
     under the same randomized kill schedule as the main protocol."""
     rng = random.Random(0xC0FFEE)
     _run_soak_phase(
-        rng, "host", transport_kind, "dynamic", N_REPLICAS, CHAOS_SECONDS
+        rng, "host", transport_kind, "dynamic", N_REPLICAS, CHAOS_SECONDS,
+        target=TARGET_STEPS,
     )
 
 
@@ -88,13 +89,12 @@ def test_extended_mixed_soak():
 
 
 def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
-                    chaos_seconds):
+                    chaos_seconds, target=20):
     import jax.numpy as jnp
 
     from torchft_tpu.manager import WorldSizeMode
     from torchft_tpu.process_group_xla import ProcessGroupXLA
 
-    target = 20
     spares = mode == "fixed_with_spares"
     wsm = (WorldSizeMode.FIXED_WITH_SPARES if spares
            else WorldSizeMode.DYNAMIC)
@@ -185,6 +185,10 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
                         with mono_lock:
                             heal_count[0] += 1
                 finals[rid] = params["w"].copy()
+                # finished: stop counting as killable, or chaos could flag
+                # this ghost and condemn the last real runner to a solo
+                # replay that diverges
+                alive[rid].clear()
                 return
             except _Killed:
                 died = True

@@ -1,0 +1,34 @@
+#!/bin/bash
+# The round-5 TPU experiment queue. MANUAL INVOCATION ONLY, and only when
+# ALL of these hold:
+#   - the tunnel is healthy AND bench.py has already confirmed the
+#     headline number on it (warm .jax_cache)
+#   - there are HOURS of margin before the driver's round-end artifact
+#     run: a sweep cell that hangs the compiler gets killed at its
+#     timeout, and killing a remote compile is the known tunnel-wedge
+#     trigger (rounds 3 and 4 both lost their artifact this way). Item 4
+#     runs the two historically-pathological cells and goes LAST.
+# Every cell is a subprocess inside mfu_sweep.py with a wall-clock
+# timeout; the sweep re-probes the backend after any timeout and stops if
+# the platform plugin has wedged.
+#
+# Queue (round-4 leftovers, docs/performance.md "queued experiments"):
+#   1. splash block ladder incl. asymmetric q/kv tiles
+#   2. --unroll 2 variant of the headline cell
+#   3. long-context row: seq 8192, remat=full, batch 2, chunk 512
+#   4. exact status codes for the two failing round-4 cells
+set -u
+cd /root/repo
+LOG=${1:-/tmp/tpu_queue_r5.log}
+{
+  echo "=== tpu_queue start $(date -u +%FT%TZ)"
+  echo "--- 1. splash block ladder (asymmetric q/kv included)"
+  python benchmarks/mfu_sweep.py --blocks --timeout 1500
+  echo "--- 2. unroll 2 on the headline cell"
+  python benchmarks/mfu_sweep.py --unroll 2 --cell full,8,0 --timeout 1500
+  echo "--- 3. long-context row seq=8192"
+  python benchmarks/mfu_sweep.py --seq 8192 --cell full,2,512 --timeout 1800
+  echo "--- 4. exact status codes for round-4 failing cells"
+  python benchmarks/mfu_sweep.py --cell none,8,0 --cell dots,16,0 --timeout 1500
+  echo "=== tpu_queue done $(date -u +%FT%TZ)"
+} >> "$LOG" 2>&1

@@ -23,21 +23,28 @@ EOF
     RC=$?
     echo "$(date +%H:%M:%S) bench rc=$RC (see $OUT)" >> "$LOG"
     if [ "$RC" = "0" ]; then
-      python - "$OUT" <<'EOF'
-import json, sys, datetime
-line = [l for l in open(sys.argv[1]) if l.startswith('{"metric"')][-1]
-rec = json.loads(line)
-if "error" not in rec:
-    rec["provenance"] = (
-        "self-recorded by benchmarks/tunnel_watch.sh on the first healthy "
-        "probe after the round-4 wedge; bench.py finished at "
-        + datetime.datetime.utcnow().strftime("%Y-%m-%dT%H:%MZ")
-        + " (see the probe timeline in the watcher log). The persistent "
-        "compilation cache (.jax_cache/) was enabled for the run; whether "
-        "it replayed or compiled fresh depends on the toolchain matching "
-        "the cache's. If BENCH_r05.json shows a TPU number, prefer it."
-    )
-    json.dump(rec, open("/root/repo/BENCH_SELF_r05.json", "w"), indent=1)
+      python - "$OUT" >> "$LOG" 2>&1 <<'EOF'
+import json, sys
+from datetime import datetime, timezone
+lines = [l for l in open(sys.argv[1]) if l.startswith('{"metric"')]
+if not lines:
+    print("BENCH_SELF: no metric line in bench output; nothing saved")
+    raise SystemExit(0)
+rec = json.loads(lines[-1])
+if "error" in rec:
+    print(f"BENCH_SELF: bench fell back ({rec['error']}); nothing saved")
+    raise SystemExit(0)
+rec["provenance"] = (
+    "self-recorded by benchmarks/tunnel_watch.sh on the first healthy "
+    "probe after the round-4 wedge; bench.py finished at "
+    + datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%MZ")
+    + " (see the probe timeline in the watcher log). The persistent "
+    "compilation cache (.jax_cache/) was enabled for the run; whether "
+    "it replayed or compiled fresh depends on the toolchain matching "
+    "the cache's. If BENCH_r05.json shows a TPU number, prefer it."
+)
+json.dump(rec, open("/root/repo/BENCH_SELF_r05.json", "w"), indent=1)
+print("BENCH_SELF: saved BENCH_SELF_r05.json")
 EOF
     fi
     exit 0

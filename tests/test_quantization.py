@@ -472,3 +472,36 @@ class TestShardedQuantizedPath:
         assert errs and any("layout mismatch" in e for e in errs)
         for pg in pgs:
             pg.shutdown()
+
+
+class TestDeviceReduceScatter:
+    def test_device_tree_stays_on_device_and_matches_host_layout(self, store):
+        """Single-device jax inputs run the fused engine; chunk ownership is
+        row-aligned identically to the host path (mixed quorums stay
+        compatible), and the result is a jax.Array."""
+        import jax
+        import jax.numpy as jnp
+
+        pgs = make_pgs(store, 2, quorum_id=71)
+        n = 1500  # chunk = ceil(ceil(1500/2)/512)*512 = 1024
+        vals = np.linspace(0, 10, n).astype(np.float32)
+        inputs = [jnp.asarray(vals), vals * 2]  # rank 0 device, rank 1 host
+
+        def run(rank):
+            return (
+                reduce_scatter_quantized([inputs[rank]], ReduceOp.SUM, pgs[rank])
+                .get_future().wait(timeout=60)
+            )
+
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            outs = list(ex.map(run, range(2)))
+        full = np.zeros(2048, np.float32)
+        full[:n] = vals * 3
+        assert isinstance(outs[0], jax.Array), "device input left the device"
+        assert outs[0].shape == (1024,) and outs[1].shape == (1024,)
+        np.testing.assert_allclose(np.asarray(outs[0]), full[:1024],
+                                   rtol=0.1, atol=0.08)
+        np.testing.assert_allclose(np.asarray(outs[1]), full[1024:],
+                                   rtol=0.1, atol=0.08)
+        for pg in pgs:
+            pg.shutdown()

@@ -555,9 +555,29 @@ def reduce_scatter_quantized(
     arrays: Sequence[Any], op: ReduceOp, pg: ProcessGroup, row: int = _ROW
 ) -> Work:
     """fp8-compressed reduce-scatter: future resolves to this rank's reduced
-    flat chunk (f32) of the concatenated input."""
+    flat chunk (f32) of the concatenated input.
+
+    Single-device jax trees run the fused Pallas engine (quantize, wire,
+    dequantize+reduce all on-accelerator — the reference keeps its
+    reduce-scatter on-GPU the same way, collectives.py:159-296) and the
+    chunk comes back as a jax.Array; numpy (and mesh-sharded) inputs use
+    the host engine. Both engines share the row-aligned chunk partition,
+    so mixed quorums exchange identically-aligned chunks."""
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"reduce_scatter_quantized supports SUM/AVG, got {op}")
+
+    if is_device_tree(arrays) and not _has_multidevice_leaf(arrays):
+        dflat, _, _ = _flatten_jax(arrays)
+
+        def run_device():
+            if pg.size() <= 1:
+                return dflat
+            acc, _chunk, _rows = _reduce_scatter_core_device(
+                dflat, op, pg, row
+            )
+            return acc
+
+        return _run_async(run_device)
 
     flat, _, _ = _flatten(arrays)
 

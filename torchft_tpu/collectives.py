@@ -560,16 +560,23 @@ def reduce_scatter_quantized(
     Single-device jax trees run the fused Pallas engine (quantize, wire,
     dequantize+reduce all on-accelerator — the reference keeps its
     reduce-scatter on-GPU the same way, collectives.py:159-296) and the
-    chunk comes back as a jax.Array; numpy (and mesh-sharded) inputs use
-    the host engine. Both engines share the row-aligned chunk partition,
-    so mixed quorums exchange identically-aligned chunks."""
+    chunk comes back as a jax.Array; numpy and mesh-sharded inputs use
+    the host engine (mesh-sharded only while fully addressable — the
+    host flatten gathers, so multi-host shardings raise on the future;
+    allreduce_quantized is the op with an SPMD engine). Both engines
+    share the row-aligned chunk partition, so mixed quorums exchange
+    identically-aligned chunks."""
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"reduce_scatter_quantized supports SUM/AVG, got {op}")
 
     if is_device_tree(arrays) and not _has_multidevice_leaf(arrays):
-        dflat, _, _ = _flatten_jax(arrays)
+        leaves = list(arrays)
 
         def run_device():
+            # flatten inside the worker: cross-leaf device disagreement
+            # (leaves committed to different devices) must resolve through
+            # the Work future like every other error in this module
+            dflat, _, _ = _flatten_jax(leaves)
             if pg.size() <= 1:
                 return dflat
             acc, _chunk, _rows = _reduce_scatter_core_device(

@@ -605,18 +605,25 @@ class Manager:
             # produce an array the next jitted computation rejects as
             # "incompatible devices". Land such leaves on the live backend
             # instead — _sync_device_world re-lands the user's own state
-            # the same way at should_commit.
-            try:
-                live_client = getattr(jax.devices()[0], "client", None)
-            except Exception:  # noqa: BLE001
-                live_client = None
+            # the same way at should_commit. LAZY on purpose: jax.devices()
+            # initializes the backend, and a pure-host tree must never
+            # trigger that (a wedged accelerator plugin hangs init — the
+            # host plane has to keep working through exactly that state).
+            live_client = [False]
 
             def _is_live(sharding) -> bool:
-                if live_client is None:
+                if live_client[0] is False:
+                    try:
+                        live_client[0] = getattr(
+                            jax.devices()[0], "client", None
+                        )
+                    except Exception:  # noqa: BLE001
+                        live_client[0] = None
+                if live_client[0] is None:
                     return True
                 try:
                     dev = next(iter(sharding.device_set))
-                    return getattr(dev, "client", None) is live_client
+                    return getattr(dev, "client", None) is live_client[0]
                 except Exception:  # noqa: BLE001
                     return False
 

@@ -142,6 +142,17 @@ class TestHSDPMesh:
         np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-4)
 
 
+def naive_causal_attention(q, k, v):
+    """Dense causal softmax reference (GQA: jnp.repeat k/v at the call
+    site). One copy for every ring/ulysses comparison in this file."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    S = q.shape[1]
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+
+
 class TestRingAttention:
     def test_matches_dense_attention(self, params):
         """Ring attention over sp=4 must equal the dense causal attention."""
@@ -173,10 +184,7 @@ class TestRingAttention:
         )
 
         # naive reference
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        expected = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v)
+        expected = naive_causal_attention(q, k, v)
 
         spec = P(None, "sp", None, None)
         with mesh:
@@ -206,10 +214,7 @@ class TestRingAttention:
 
         k_rep = jnp.repeat(k, Hq // Hkv, axis=2)
         v_rep = jnp.repeat(v, Hq // Hkv, axis=2)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_rep) / np.sqrt(hd)
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        expected = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, -1), v_rep)
+        expected = naive_causal_attention(q, k_rep, v_rep)
 
         spec = P(None, "sp", None, None)
         with mesh:
@@ -221,3 +226,110 @@ class TestRingAttention:
                 check_vma=False,
             )(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (parallel/ulysses.py): the second
+    long-context strategy next to ring attention."""
+
+    def test_matches_dense_attention(self, params):
+        """Ulysses over sp=2 must equal dense causal attention at the model
+        level (debug config: 4 q heads / 2 kv heads; tp=1 so sp=2 divides
+        both per-device head counts)."""
+        from torchft_tpu.parallel.ulysses import make_ulysses_attention_fn
+
+        mesh = make_hsdp_mesh(dp=1, fsdp=1, tp=1, sp=2)
+        uly_fn = make_ulysses_attention_fn(mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, CFG.vocab_size)
+
+        ref = llama_forward(params, tokens, CFG)
+        sharded = shard_params(params, mesh, llama_param_specs(CFG))
+        out = jax.jit(
+            lambda p, t: llama_forward(p, t, CFG, attention_fn=uly_fn)
+        )(sharded, tokens)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=3e-4)
+
+    def test_unit_matches_naive(self):
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from torchft_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_hsdp_mesh(dp=1, fsdp=1, tp=1, sp=4)
+        B, S, H, hd = 2, 64, 4, 8
+        key = jax.random.PRNGKey(6)
+        q, k, v = (
+            jax.random.normal(k_, (B, S, H, hd), jnp.float32)
+            for k_ in jax.random.split(key, 3)
+        )
+        expected = naive_causal_attention(q, k, v)
+
+        spec = P(None, "sp", None, None)
+        with mesh:
+            out = shard_map(
+                partial(ulysses_attention, cfg=CFG, axis_name="sp"),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+    def test_gqa_ulysses(self):
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from torchft_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_hsdp_mesh(dp=1, fsdp=1, tp=1, sp=2)
+        B, S, Hq, Hkv, hd = 1, 32, 4, 2, 8
+        key = jax.random.PRNGKey(7)
+        kq, kk, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, S, Hq, hd), jnp.float32)
+        k = jax.random.normal(kk, (B, S, Hkv, hd), jnp.float32)
+        v = jax.random.normal(kv_, (B, S, Hkv, hd), jnp.float32)
+
+        k_rep = jnp.repeat(k, Hq // Hkv, axis=2)
+        v_rep = jnp.repeat(v, Hq // Hkv, axis=2)
+        expected = naive_causal_attention(q, k_rep, v_rep)
+
+        spec = P(None, "sp", None, None)
+        with mesh:
+            out = shard_map(
+                partial(ulysses_attention, cfg=CFG, axis_name="sp"),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+    def test_indivisible_heads_fail_loudly(self):
+        """sp=4 cannot divide 2 kv heads: a clear ValueError, not silent
+        garbage (the documented ring-attention-instead case)."""
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from torchft_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_hsdp_mesh(dp=1, fsdp=1, tp=1, sp=4)
+        B, S, Hq, Hkv, hd = 1, 32, 4, 2, 8
+        q = jnp.ones((B, S, Hq, hd), jnp.float32)
+        k = jnp.ones((B, S, Hkv, hd), jnp.float32)
+        v = jnp.ones((B, S, Hkv, hd), jnp.float32)
+        spec = P(None, "sp", None, None)
+        with pytest.raises(ValueError, match="ring attention"):
+            with mesh:
+                shard_map(
+                    partial(ulysses_attention, cfg=CFG, axis_name="sp"),
+                    mesh=mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    check_vma=False,
+                )(q, k, v)

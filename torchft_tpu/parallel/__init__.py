@@ -6,6 +6,7 @@ from torchft_tpu.parallel.mesh import (
     shard_params,
 )
 from torchft_tpu.parallel.ring_attention import make_ring_attention_fn, ring_attention
+from torchft_tpu.parallel.ulysses import make_ulysses_attention_fn, ulysses_attention
 
 __all__ = [
     "make_hsdp_mesh",
@@ -15,4 +16,6 @@ __all__ = [
     "make_train_step",
     "ring_attention",
     "make_ring_attention_fn",
+    "ulysses_attention",
+    "make_ulysses_attention_fn",
 ]

@@ -1,0 +1,95 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two long-context strategies (ring attention in
+ring_attention.py is the first; the reference has neither — SURVEY.md §5
+delegates sequence scaling to torchtitan). Instead of rotating KV shards
+around the ``sp`` ring, one ``all_to_all`` re-partitions the sharding
+axis: every device trades its sequence shard of ALL heads for the FULL
+sequence of a head subset, runs ordinary causal attention locally (the
+ops.attention dispatcher — splash/flash on TPU), and a second
+``all_to_all`` restores sequence sharding.
+
+Trade-offs vs ring attention:
+
+- two all-to-alls per layer instead of P-1 ppermute hops — fewer, larger
+  ICI transfers, and the local attention is a single dense-tiled kernel
+  call (MXU-friendly) rather than P accumulation steps;
+- with a tiled kernel (splash/flash on TPU) per-device attention
+  memory matches ring's O(S * S/P); on the XLA fallback path the local
+  attention materializes full [B, H/sp, S, S] scores — O(S^2) — so
+  long-context off-TPU runs belong on ring attention;
+- heads must divide: ``sp`` must divide the per-device head counts
+  (after tp). GQA models with few KV heads hit this first — ring
+  attention has no such constraint, which is why it stays the default.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ulysses_attention", "make_ulysses_attention_fn"]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    cfg: Any,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Call inside shard_map. q: [B, S_loc, Hq, hd]; k/v: [B, S_loc,
+    Hkv, hd] (sequence shards in mesh-axis order). Returns [B, S_loc,
+    Hq, hd]."""
+    from torchft_tpu.ops.attention import causal_attention
+
+    sp = jax.lax.psum(1, axis_name)
+    if sp == 1:
+        return causal_attention(q, k, v, cfg)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % sp or hkv % sp:
+        raise ValueError(
+            f"ulysses needs sp={sp} to divide the per-device head counts "
+            f"(q heads {hq}, kv heads {hkv}); use ring attention for this "
+            "config"
+        )
+
+    # head-scatter / sequence-gather: [B, S_loc, H, hd] -> [B, S, H/sp, hd]
+    # (tiled all_to_all concatenates shards in axis order, so the gathered
+    # sequence is in global order)
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2,
+        concat_axis=1, tiled=True,
+    )
+    out = causal_attention(a2a(q), a2a(k), a2a(v), cfg)
+    # inverse: sequence-scatter / head-gather
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def make_ulysses_attention_fn(mesh: Mesh):
+    """Attention fn for llama_forward: shard_map of ulysses_attention.
+
+    Same sharding contract as make_ring_attention_fn: batch over
+    (dp, fsdp), sequence over sp, heads over tp — and additionally sp
+    must divide the PER-DEVICE head counts (n_heads/tp, n_kv_heads/tp).
+    """
+    from jax import shard_map
+
+    qspec = P(("dp", "fsdp"), "sp", "tp", None)
+
+    def attention_fn(q, k, v, cfg):
+        fn = shard_map(
+            partial(ulysses_attention, cfg=cfg),
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec),
+            out_specs=qspec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+
+    return attention_fn

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ring_attention", "make_ring_attention_fn"]
+__all__ = ["ring_attention", "make_ring_attention_fn", "make_sp_attention_fn"]
 
 
 def ring_attention(
@@ -87,19 +87,18 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
-def make_ring_attention_fn(mesh: Mesh):
-    """Attention fn for llama_forward: shard_map of ring_attention.
-
-    Sharding: batch over (dp, fsdp), sequence over sp, heads over tp
-    (tp must divide n_kv_heads).
-    """
+def make_sp_attention_fn(mesh: Mesh, kernel):
+    """Shared shard_map wrapper for the sequence-parallel attention
+    strategies: ``kernel(q, k, v, cfg)`` runs per shard under the one
+    (dp, fsdp) x sp x tp sharding contract, so ring and ulysses cannot
+    drift apart on specs."""
     from jax import shard_map
 
     qspec = P(("dp", "fsdp"), "sp", "tp", None)
 
     def attention_fn(q, k, v, cfg):
         fn = shard_map(
-            partial(ring_attention, axis_name="sp"),
+            partial(kernel, cfg=cfg),
             mesh=mesh,
             in_specs=(qspec, qspec, qspec),
             out_specs=qspec,
@@ -108,3 +107,15 @@ def make_ring_attention_fn(mesh: Mesh):
         return fn(q, k, v)
 
     return attention_fn
+
+
+def make_ring_attention_fn(mesh: Mesh):
+    """Attention fn for llama_forward: shard_map of ring_attention.
+
+    Sharding: batch over (dp, fsdp), sequence over sp, heads over tp
+    (tp must divide n_kv_heads).
+    """
+    def kernel(q, k, v, cfg):
+        return ring_attention(q, k, v, axis_name="sp")
+
+    return make_sp_attention_fn(mesh, kernel)

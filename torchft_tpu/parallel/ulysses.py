@@ -29,7 +29,7 @@ from functools import partial
 from typing import Any
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 __all__ = ["ulysses_attention", "make_ulysses_attention_fn"]
 
@@ -74,22 +74,14 @@ def ulysses_attention(
 def make_ulysses_attention_fn(mesh: Mesh):
     """Attention fn for llama_forward: shard_map of ulysses_attention.
 
-    Same sharding contract as make_ring_attention_fn: batch over
-    (dp, fsdp), sequence over sp, heads over tp — and additionally sp
-    must divide the PER-DEVICE head counts (n_heads/tp, n_kv_heads/tp).
+    Same sharding contract as make_ring_attention_fn (one shared wrapper,
+    make_sp_attention_fn): batch over (dp, fsdp), sequence over sp, heads
+    over tp — and additionally sp must divide the PER-DEVICE head counts
+    (n_heads/tp, n_kv_heads/tp).
     """
-    from jax import shard_map
+    from torchft_tpu.parallel.ring_attention import make_sp_attention_fn
 
-    qspec = P(("dp", "fsdp"), "sp", "tp", None)
+    def kernel(q, k, v, cfg):
+        return ulysses_attention(q, k, v, cfg, axis_name="sp")
 
-    def attention_fn(q, k, v, cfg):
-        fn = shard_map(
-            partial(ulysses_attention, cfg=cfg),
-            mesh=mesh,
-            in_specs=(qspec, qspec, qspec),
-            out_specs=qspec,
-            check_vma=False,
-        )
-        return fn(q, k, v)
-
-    return attention_fn
+    return make_sp_attention_fn(mesh, kernel)

@@ -48,6 +48,7 @@ def train(args) -> None:
         shard_params,
     )
     from torchft_tpu.parallel.ring_attention import make_ring_attention_fn
+    from torchft_tpu.parallel.ulysses import make_ulysses_attention_fn
     from torchft_tpu.process_group import ProcessGroupHost
 
     replica_id = int(os.environ.get("REPLICA_GROUP_ID", args.replica_id))
@@ -60,7 +61,10 @@ def train(args) -> None:
     specs = llama_param_specs(cfg)
     param_shardings = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
     tok_sharding = batch_sharding(mesh)
-    attention_fn = make_ring_attention_fn(mesh)
+    attention_fn = (
+        make_ulysses_attention_fn(mesh) if args.attention == "ulysses"
+        else make_ring_attention_fn(mesh)
+    )
 
     params = shard_params(
         llama_init(jax.random.PRNGKey(replica_id), cfg), mesh, specs
@@ -303,9 +307,14 @@ def demo(args) -> None:
     def spawn(rid):
         env = dict(os.environ, TORCHFT_LIGHTHOUSE=addr, REPLICA_GROUP_ID=str(rid))
         return subprocess.Popen(
+            # ulysses needs sp>1 and sp | per-device head counts: drop tp
+            # and give sp the pair so the all_to_all path actually runs
             [sys.executable, __file__, "--config", args.config,
              "--steps", str(args.steps), "--virtual-chips", "4",
-             "--fsdp", "2", "--sp", "1", "--tp", "2",
+             "--fsdp", "2",
+             *(["--sp", "2", "--tp", "1"] if args.attention == "ulysses"
+               else ["--sp", "1", "--tp", "2"]),
+             "--attention", args.attention,
              "--transport", args.transport,
              "--batch-size", str(args.batch_size), "--seq-len", str(args.seq_len)],
             env=env,
@@ -352,6 +361,12 @@ if __name__ == "__main__":
     parser.add_argument("--fsdp", type=int, default=1)
     parser.add_argument("--sp", type=int, default=1)
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--attention", choices=["ring", "ulysses"],
+                        default="ring",
+                        help="sequence-parallel strategy over sp: ring "
+                             "(default; no head-count constraint) or "
+                             "ulysses (all-to-all; sp must divide the "
+                             "per-device head counts)")
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--transport", choices=["http", "pg"], default="http",
                         help="live-healing transport: http (default) or pg "

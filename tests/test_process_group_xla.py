@@ -293,6 +293,58 @@ class TestLocalMode:
             assert isinstance(out["g"], jax.Array)
             np.testing.assert_allclose(np.asarray(out["g"]), np.full(4, 1.5))
 
+    def test_manager_quantized_allreduce_on_device(self, store):
+        """should_quantize over a device-native PG: the fp8 pipeline packs
+        the compressed wire into uint8 device arrays and ships it through
+        the PG's own collectives (the gate that silently disabled this is
+        gone)."""
+        from torchft_tpu.manager import Manager
+
+        world = 2
+        pgs = make_pgs(store, world, quorum_id=6)
+
+        class _Mgr:
+            def __init__(self, pg):
+                self._pg = pg
+                self._logger = _Log()
+
+            errored = lambda self: None
+            wait_quorum = lambda self: None
+            num_participants = lambda self: world
+            is_participating = lambda self: True
+            report_error = lambda self, e: None
+            _bump_metric = lambda self, name: None
+
+            def wrap_future(self, fut, default, **kwargs):
+                return fut
+
+            allreduce = Manager.allreduce
+
+        class _Log:
+            def exception(self, *a, **k):
+                pass
+
+            def warning(self, *a, **k):
+                pass
+
+        rng = np.random.RandomState(5)
+        base = rng.randn(600).astype(np.float32)
+        mgrs = [_Mgr(pgs[r]) for r in range(world)]
+        outs = run_parallel(
+            world,
+            lambda r: mgrs[r]
+            .allreduce({"g": jnp.asarray(base * (r + 1))},
+                       should_quantize=True)
+            .get_future()
+            .wait(60),
+        )
+        amax = float(np.abs(base).max())
+        for out in outs:
+            assert isinstance(out["g"], jax.Array)
+            np.testing.assert_allclose(
+                np.asarray(out["g"]), base * 1.5, rtol=0.15, atol=amax / 4
+            )
+
 
 _DIST_WORKER = r"""
 import sys, time

@@ -162,27 +162,76 @@ def _device_from_wire(tuples: List[tuple], row: int):
     return deq.reshape(world, rows * row)
 
 
+def _pack_wire_device(q, scales):
+    """(rows, row) fp8 + (rows, 1) f32 scales -> ONE flat uint8 device
+    array. For device-native PGs the compressed wire must be a single
+    array (a jitted XLA collective cannot move host tuples) — and packing
+    keeps the whole exchange on device: on hardware the alltoall of the
+    ~1 byte/element payload rides ICI/DCN with zero host staging."""
+    import jax
+    import jax.numpy as jnp
+
+    qb = jax.lax.bitcast_convert_type(q, jnp.uint8).reshape(-1)
+    sb = jax.lax.bitcast_convert_type(
+        scales.astype(jnp.float32), jnp.uint8
+    ).reshape(-1)
+    return jnp.concatenate([qb, sb])
+
+
+def _unpack_dequant_device(bufs, rows: int, row: int):
+    """Inverse of _pack_wire_device over a list of same-shape wires:
+    dequantize all in ONE fused kernel call; returns (len(bufs), rows*row)
+    f32 on device."""
+    import jax
+    import jax.numpy as jnp
+
+    world = len(bufs)
+    stacked = jnp.stack([jnp.asarray(b) for b in bufs])  # (w, nbytes) u8
+    qb = stacked[:, : rows * row].reshape(world * rows, row)
+    sb = stacked[:, rows * row:].reshape(world * rows, 1, 4)
+    q = jax.lax.bitcast_convert_type(qb, jnp.float8_e4m3fn)
+    s = jax.lax.bitcast_convert_type(sb, jnp.float32).reshape(world * rows, 1)
+    deq = fused_dequantize_fp8(q, s, world * rows * row, row)
+    return deq.reshape(world, rows * row)
+
+
 def _reduce_scatter_core_device(flat, op: ReduceOp, pg: ProcessGroup, row: int):
     """Device-path pipeline: pad so chunks are whole fp8 rows, quantize the
     whole buffer in one Pallas call, slice per destination for the wire,
-    then dequantize+reduce the received chunks on device."""
+    then dequantize+reduce the received chunks on device.
+
+    Wire format by PG plane: device-native PGs exchange packed uint8
+    device arrays (the collective stays on device end to end); host PGs
+    get the host tuple wire (uint8 payload, f32 scales, n)."""
     import jax.numpy as jnp
 
     world = pg.size()
+    device_pg = bool(getattr(pg, "device_native", False))
     chunk_rows = max(1, _ceil_div(_ceil_div(int(flat.size), world), row))
     chunk = chunk_rows * row
     padded = jnp.zeros((chunk * world,), jnp.float32).at[: flat.size].set(flat)
     q, scales, _ = fused_quantize_fp8(padded, row)  # (world*chunk_rows, row)
-    sends = [
-        _wire_from_device(
-            q[r * chunk_rows:(r + 1) * chunk_rows],
-            scales[r * chunk_rows:(r + 1) * chunk_rows],
-            chunk,
-        )
-        for r in range(world)
-    ]
-    recvd = pg.alltoall(sends).get_future().wait()
-    deq = _device_from_wire(list(recvd), row)  # (world, chunk) f32 on device
+    if device_pg:
+        sends = [
+            _pack_wire_device(
+                q[r * chunk_rows:(r + 1) * chunk_rows],
+                scales[r * chunk_rows:(r + 1) * chunk_rows],
+            )
+            for r in range(world)
+        ]
+        recvd = pg.alltoall(sends).get_future().wait()
+        deq = _unpack_dequant_device(list(recvd), chunk_rows, row)
+    else:
+        sends = [
+            _wire_from_device(
+                q[r * chunk_rows:(r + 1) * chunk_rows],
+                scales[r * chunk_rows:(r + 1) * chunk_rows],
+                chunk,
+            )
+            for r in range(world)
+        ]
+        recvd = pg.alltoall(sends).get_future().wait()
+        deq = _device_from_wire(list(recvd), row)  # (world, chunk) f32
     acc = deq.sum(axis=0)
     if op == ReduceOp.AVG:
         acc = acc / world
@@ -190,15 +239,19 @@ def _reduce_scatter_core_device(flat, op: ReduceOp, pg: ProcessGroup, row: int):
 
 
 def _allreduce_quantized_device(flat, shapes, dtypes, op, pg, row):
-    import jax.numpy as jnp
-
     world = pg.size()
+    device_pg = bool(getattr(pg, "device_native", False))
     acc, chunk, chunk_rows = _reduce_scatter_core_device(flat, op, pg, row)
 
     q, scales, _ = fused_quantize_fp8(acc, row)
-    gathered = pg.allgather([_wire_from_device(q, scales, chunk)]) \
-        .get_future().wait()
-    deq = _device_from_wire([g[0] for g in gathered], row)  # (world, chunk)
+    if device_pg:
+        gathered = pg.allgather([_pack_wire_device(q, scales)]) \
+            .get_future().wait()
+        deq = _unpack_dequant_device([g[0] for g in gathered], chunk_rows, row)
+    else:
+        gathered = pg.allgather([_wire_from_device(q, scales, chunk)]) \
+            .get_future().wait()
+        deq = _device_from_wire([g[0] for g in gathered], row)  # (w, chunk)
     out = deq.reshape(world * chunk)[: flat.size]
     return _unflatten_jax(out, shapes, dtypes)
 
@@ -383,21 +436,60 @@ def _allreduce_quantized_sharded(arrays, op: ReduceOp, pg: ProcessGroup,
         Q = np.concatenate([Q, np.zeros((pad_rows, row), np.uint8)], axis=0)
         S = np.concatenate([S, np.ones(pad_rows, np.float32)])
     chunk = chunk_rows * row
+    device_pg = bool(getattr(pg, "device_native", False))
 
-    sends = [
-        (Q[r * chunk_rows:(r + 1) * chunk_rows],
-         S[r * chunk_rows:(r + 1) * chunk_rows], chunk, sig)
-        for r in range(world)
-    ]
-    recvd = list(pg.alltoall(sends).get_future().wait())
-    for t in recvd:
-        if len(t) != 4 or t[3] != sig:
+    def _pack_host(q_rows: np.ndarray, s_rows: np.ndarray) -> np.ndarray:
+        """Host-side packed wire (same layout as _pack_wire_device, sig
+        appended as 4 LE bytes): a device-native PG's jitted collective
+        moves single arrays, not host tuples."""
+        return np.concatenate([
+            q_rows.reshape(-1),
+            s_rows.astype(np.float32).view(np.uint8).reshape(-1),
+            np.frombuffer(
+                int(sig).to_bytes(4, "little"), dtype=np.uint8
+            ).copy(),
+        ])
+
+    def _unpack_host(buf, n_rows: int):
+        """-> (q (rows,row) u8, scales (rows,) f32); verifies the sig."""
+        host = np.asarray(buf).view(np.uint8).reshape(-1)
+        got_sig = int.from_bytes(bytes(host[-4:]), "little")
+        if got_sig != sig:
             raise RuntimeError(
                 "quantized-allreduce wire layout mismatch: a peer sent "
-                f"signature {t[3] if len(t) == 4 else '<legacy 3-tuple>'} "
-                f"vs local {sig} — ranks must hold identically-sharded "
-                "leaves (same meshes, specs, and leaf order)"
+                f"signature {got_sig} vs local {sig} — ranks must hold "
+                "identically-sharded leaves (same meshes, specs, and leaf "
+                "order)"
             )
+        q_part = host[: n_rows * row].reshape(n_rows, row)
+        s_part = host[n_rows * row:-4].view(np.float32).reshape(n_rows)
+        return q_part, s_part
+
+    if device_pg:
+        sends = [
+            _pack_host(Q[r * chunk_rows:(r + 1) * chunk_rows],
+                       S[r * chunk_rows:(r + 1) * chunk_rows])
+            for r in range(world)
+        ]
+        recvd_packed = list(pg.alltoall(sends).get_future().wait())
+        recvd = [
+            (*_unpack_host(b, chunk_rows), chunk) for b in recvd_packed
+        ]
+    else:
+        sends = [
+            (Q[r * chunk_rows:(r + 1) * chunk_rows],
+             S[r * chunk_rows:(r + 1) * chunk_rows], chunk, sig)
+            for r in range(world)
+        ]
+        recvd = list(pg.alltoall(sends).get_future().wait())
+        for t in recvd:
+            if len(t) != 4 or t[3] != sig:
+                raise RuntimeError(
+                    "quantized-allreduce wire layout mismatch: a peer sent "
+                    f"signature {t[3] if len(t) == 4 else '<legacy 3-tuple>'} "
+                    f"vs local {sig} — ranks must hold identically-sharded "
+                    "leaves (same meshes, specs, and leaf order)"
+                )
 
     # chunk-sized stages run on the default device via the fused kernels
     # (a chunk is 1/world of the compressed buffer — small next to the
@@ -407,21 +499,30 @@ def _allreduce_quantized_sharded(arrays, op: ReduceOp, pg: ProcessGroup,
     if op == ReduceOp.AVG:
         acc = acc / world
     q2, s2, _ = fused_quantize_fp8(acc, row)
-    gathered = pg.allgather([
-        (np.asarray(q2).view(np.uint8), np.asarray(s2).reshape(-1), chunk,
-         sig)
-    ]).get_future().wait()
-    for g in gathered:
-        if len(g[0]) != 4 or g[0][3] != sig:
-            raise RuntimeError(
-                "quantized-allreduce wire layout mismatch in allgather"
-            )
+    q2_host = np.asarray(q2).view(np.uint8)
+    s2_host = np.asarray(s2).reshape(-1)
+    if device_pg:
+        gathered_packed = pg.allgather([_pack_host(q2_host, s2_host)]) \
+            .get_future().wait()
+        gathered_qs = [
+            _unpack_host(g[0], chunk_rows) for g in gathered_packed
+        ]
+    else:
+        gathered = pg.allgather([(q2_host, s2_host, chunk, sig)]) \
+            .get_future().wait()
+        for g in gathered:
+            if len(g[0]) != 4 or g[0][3] != sig:
+                raise RuntimeError(
+                    "quantized-allreduce wire layout mismatch in allgather"
+                )
+        gathered_qs = [
+            (np.asarray(g[0][0]).view(np.uint8), np.asarray(g[0][1]))
+            for g in gathered
+        ]
 
-    Qr = np.concatenate(
-        [np.asarray(g[0][0]).view(np.uint8) for g in gathered], axis=0
-    )[:total_rows]
+    Qr = np.concatenate([q for q, _ in gathered_qs], axis=0)[:total_rows]
     Sr = np.concatenate(
-        [np.asarray(g[0][1]).reshape(-1) for g in gathered]
+        [s.reshape(-1) for _, s in gathered_qs]
     )[:total_rows]
 
     out, off = [], 0

@@ -644,27 +644,18 @@ class Manager:
         if self.errored():
             return DummyWork(zeros())
 
-        if should_quantize and getattr(self._pg, "device_native", False):
-            # fp8 compression exists to save host/DCN wire bandwidth; the
-            # device plane's collectives already ride ICI/DCN natively and
-            # don't speak the host wire-tuple format.
-            if not getattr(self, "_warned_quantize_device_native", False):
-                self._warned_quantize_device_native = True
-                self._logger.warning(
-                    "should_quantize ignored: PG is device-native"
-                )
-            should_quantize = False
-
         self.wait_quorum()
         num_participants = self.num_participants()
 
         # Device-native PGs (ProcessGroupXLA) take jax.Arrays straight
         # through — the collective runs on device over ICI/DCN with no
         # host staging (VERDICT weak #4: the D2H round-trip on the caller
-        # thread). The quantized path likewise keeps jax.Arrays on device:
-        # the Pallas kernels quantize there and only the compressed payload
-        # crosses to the host wire (collectives.py). Host-plane PGs with
-        # plain numpy inputs get the numpy staging they require.
+        # thread). The quantized path likewise keeps everything on device:
+        # the Pallas kernels quantize there and the compressed payload
+        # ships as packed uint8 device arrays through the PG's own
+        # collectives (collectives.py _pack_wire_device), so on hardware
+        # the fp8 exchange rides ICI with zero host staging. Host-plane
+        # PGs with plain numpy inputs get the numpy staging they require.
         # Only a device-native PG (ProcessGroupXLA) bypasses the staging
         # worker: its ops rendezvous by (kind, seq) so issue order across
         # threads cannot mismatch. On a host PG EVERYTHING — including the

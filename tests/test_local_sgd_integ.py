@@ -404,14 +404,28 @@ class TestDeviceNativeDiLoCo:
     device-resident DiLoCo fragments — pseudogradient, allreduce, outer
     step, and merge all as jax.Arrays; no host staging anywhere."""
 
-    def test_two_replicas_converge_on_device_plane(self):
+    @pytest.mark.parametrize("quantize", [False, True])
+    def test_two_replicas_converge_on_device_plane(self, quantize):
+        """quantize=True additionally proves the fp8 pseudograd pipeline
+        rides the XLA PG's own collectives via the packed uint8 device
+        wire (collectives._pack_wire_device)."""
         import jax
         import jax.numpy as jnp
 
+        import torchft_tpu.collectives as _coll
         from torchft_tpu.process_group_xla import ProcessGroupXLA
 
         if len(jax.devices()) < 2:
             pytest.skip("needs >= 2 (virtual) devices")
+
+        packed_calls = []
+        real_pack = _coll._pack_wire_device
+
+        def _pack_spy(*a, **k):
+            packed_calls.append(1)
+            return real_pack(*a, **k)
+
+        _coll._pack_wire_device = _pack_spy
 
         # determinism needs both replicas in one quorum: a lighthouse with
         # min_replicas=1 + short join timeout can form singleton quorums
@@ -444,6 +458,7 @@ class TestDeviceNativeDiLoCo:
                 diloco = DiLoCo(
                     manager, state["params"], outer_tx=optax.sgd(1.0),
                     sync_every=SYNC_EVERY,
+                    should_quantize=quantize,
                     get_params=lambda: state["params"],
                 )
                 assert all(f._on_device for f in diloco.fragments)
@@ -466,8 +481,16 @@ class TestDeviceNativeDiLoCo:
         try:
             results = run_threads([lambda r=r: replica(r) for r in range(2)])
         finally:
+            _coll._pack_wire_device = real_pack
             lighthouse.shutdown()
+        if quantize:
+            assert packed_calls, (
+                "quantized pseudograds never used the packed device wire"
+            )
+        else:
+            assert not packed_calls
         # both replicas hold bitwise-identical global params
         np.testing.assert_array_equal(results[0], results[1])
         # and the averaged outer trajectory moved them off init
         assert float(np.abs(results[0]).sum()) > 0
+

@@ -19,6 +19,11 @@ import json
 import sys
 import time
 
+# tok/s of each timing window from the most recent timed_train_step call
+# (same module-global reporting pattern as ops.attention.LAST_DISPATCH):
+# the return signature stays (tok/s, mfu) so sweep children never break
+LAST_WINDOWS: "list[float]" = []
+
 
 def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
                      loss_chunk=0, master_f32=False):
@@ -88,13 +93,23 @@ def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
     params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
     float(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
-    float(loss)  # steps chain through donated params
-    dt = time.perf_counter() - t0
+    # best-of-2 timing windows: the device repeats the same cached
+    # executable, so window spread is the 1-vCPU host's scheduler (observed
+    # 41.8-43.1k tok/s across replays of identical work, docs/performance.md)
+    # — the max is the closer estimate of the chip's rate, and the spread
+    # rides in the artifact so the noise stays visible
+    window_tps = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = jstep(params, opt_state, tokens, tokens)
+        float(loss)  # steps chain through donated params; value fetch = barrier
+        dt = time.perf_counter() - t0
+        window_tps.append(batch * seq * steps / dt)
 
-    tokens_per_sec = batch * seq * steps / dt
+    global LAST_WINDOWS
+    LAST_WINDOWS = list(window_tps)
+    tokens_per_sec = max(window_tps)
     flops_per_token = 6 * cfg.num_params()  # fwd+bwd dense approximation
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
     return tokens_per_sec, mfu
@@ -237,13 +252,14 @@ def main() -> None:
     from torchft_tpu.ops import attention as _attn
 
     first_err = None
-    result = None  # (tokens_per_sec, mfu, "requested:resolved")
+    result = None  # (tokens_per_sec, mfu, windows, "requested:resolved")
     clean_peak = True  # no failed mode allocated before the winner ran
     for mode in attention_modes:
         os.environ["TORCHFT_TPU_ATTENTION"] = mode
         try:
             tps_m, mfu_m = timed_train_step(cfg, batch, seq, steps)
-            result = (tps_m, mfu_m, f"{mode}:{_attn.LAST_DISPATCH}")
+            result = (tps_m, mfu_m, list(LAST_WINDOWS),
+                      f"{mode}:{_attn.LAST_DISPATCH}")
             break
         except Exception as e:  # noqa: BLE001
             # the first failure is the root cause (later modes usually fail
@@ -253,7 +269,7 @@ def main() -> None:
             print(f"# attention mode {mode!r} failed: {e}", file=sys.stderr)
     if result is None:
         raise first_err
-    tokens_per_sec, mfu, mode = result
+    tokens_per_sec, mfu, windows, mode = result
     n_params = cfg.num_params()
 
     record = {
@@ -268,6 +284,9 @@ def main() -> None:
         # a silent in-dispatch fallback to the slow path must be visible in
         # the artifact, not just implied by the requested mode
         "attention_mode": mode,
+        # both timing windows (tok/s): value is the max; the spread is the
+        # 1-vCPU host's scheduler, kept visible rather than averaged in
+        "windows_tok_s": [round(w, 1) for w in windows],
     }
     # peak_bytes_in_use is process-lifetime: a failed earlier attention mode
     # that allocated before dying would inflate it, so only record the peak

@@ -50,6 +50,11 @@ def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
     from torchft_tpu.models.llama import llama_init, llama_loss
     from torchft_tpu.utils import peak_flops_per_chip
 
+    # reset up front so a failed call can't leave the previous call's
+    # windows attributed to this config by an error-path reader
+    global LAST_WINDOWS
+    LAST_WINDOWS = []
+
     params = llama_init(jax.random.PRNGKey(0), cfg)
     if master_f32:
         compute_dtype = cfg.dtype
@@ -107,7 +112,6 @@ def timed_train_step(cfg, batch, seq, steps, remat="full", lr=3e-4,
         dt = time.perf_counter() - t0
         window_tps.append(batch * seq * steps / dt)
 
-    global LAST_WINDOWS
     LAST_WINDOWS = list(window_tps)
     tokens_per_sec = max(window_tps)
     flops_per_token = 6 * cfg.num_params()  # fwd+bwd dense approximation

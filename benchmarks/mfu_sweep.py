@@ -100,7 +100,9 @@ def main():
     ap.add_argument("--blocks", action="store_true",
                     help="sweep splash block sizes instead of the remat matrix")
     ap.add_argument("--timeout", type=float, default=1200.0,
-                    help="per-config wall-clock budget (compile + 10 steps)")
+                    help="per-config wall-clock budget (compile + warmup + "
+                         "2 timed 10-step windows; a TIMEOUT kill is the "
+                         "wedge-risk last resort — budget generously)")
     ap.add_argument("--unroll", type=int, default=0,
                     help="set TORCHFT_TPU_SCAN_UNROLL for every cell "
                          "(layer-scan unroll factor; 0 = leave unset)")

@@ -2,10 +2,13 @@
 accelerator. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-On a real TPU chip it times the bf16 adamw train step of a ~349M-param Llama
-(the largest per-chip config that leaves room for optimizer state on a 16GB
+On a real TPU chip it times the bf16 adamw train step of a ~1.07B-param
+Llama (`bench_1b`, 0.516 MFU measured round 5 — the dim-2048 matmuls tile
+the MXU 16-wide; ~6 GiB adamw state leaves compile headroom on a 16 GiB
 v5e; the Llama-3-8B HSDP target shards this same code over a pod — see
-BASELINE.md). The reference publishes no benchmark numbers (BASELINE.md), so
+BASELINE.md), then re-measures the rounds-<=4 ~349M config into
+`bench_350m_*` fields on the same line for cross-round continuity.
+The reference publishes no benchmark numbers (BASELINE.md), so
 vs_baseline is reported against the theoretical-peak-based MFU denominator:
 vs_baseline = achieved/peak model-flops (MFU), where beating the reference
 means any nonzero stable number survives replica churn; recovery wall-clock
@@ -228,7 +231,12 @@ def main() -> None:
     from torchft_tpu.models.llama import CONFIGS
 
     if on_tpu:
-        cfg = CONFIGS["bench_350m"]
+        # flagship: the ~1.07B config measured 0.516 MFU (round-5 sweep) —
+        # dim-2048 matmuls tile the MXU 16-wide, proving the 350M config's
+        # 0.458 plateau was small-matmul overhead, not a bandwidth floor.
+        # The 350M cell is re-measured below into bench_350m_* fields so
+        # rounds <=4 stay directly comparable (docs/performance.md).
+        cfg = CONFIGS["bench_1b"]
         batch, seq, steps = 8, 2048, 10
     else:
         cfg = CONFIGS["tiny"]
@@ -303,6 +311,23 @@ def main() -> None:
         detail = ("init hung (wedged tunnel?)" if probe == "hung"
                   else "init crashed (see stderr)")
         record["error"] = f"accelerator {detail}; CPU fallback"
+
+    # cross-round continuity row: rounds <=4's headline was the 350M
+    # config — re-measure it with the winning attention mode so the
+    # artifact keeps a directly comparable number next to the flagship's.
+    # Best-effort: its loss must never cost the headline above.
+    if on_tpu:
+        try:
+            # TORCHFT_TPU_ATTENTION still holds the winning requested mode
+            # from the fallback loop above, so the continuity row runs the
+            # same kernel as the flagship
+            tps_350m, mfu_350m = timed_train_step(
+                CONFIGS["bench_350m"], batch, seq, steps
+            )
+            record["bench_350m_tok_s"] = round(tps_350m, 1)
+            record["bench_350m_mfu"] = round(mfu_350m, 4)
+        except Exception as e:  # noqa: BLE001
+            record["bench_350m_error"] = str(e)[:200]
 
     # FT metrics ride the same line; a failure here must never cost the
     # headline number. Host plane at the legacy 8 MB payload (comparable to

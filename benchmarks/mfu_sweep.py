@@ -106,6 +106,10 @@ def main():
     ap.add_argument("--unroll", type=int, default=0,
                     help="set TORCHFT_TPU_SCAN_UNROLL for every cell "
                          "(layer-scan unroll factor; 0 = leave unset)")
+    ap.add_argument("--model", default="bench_350m",
+                    help="CONFIGS key to bench (default bench_350m, the "
+                         "cross-round headline config; bench_1b measures "
+                         "the larger-matmul regime on the same chip)")
     ap.add_argument("--seq", type=int, default=2048,
                     help="sequence length (long-context cells: pair a "
                          "longer --seq with a smaller batch and a nonzero "
@@ -154,16 +158,24 @@ def main():
                  "bench_350m config would grind for hours on CPU (use "
                  "bench.py, which falls back to tiny).")
 
-    cfg, seq = "bench_350m", args.seq
+    from torchft_tpu.models.llama import CONFIGS
+
+    if args.model not in CONFIGS:
+        sys.exit(f"--model {args.model!r}: not in CONFIGS "
+                 f"({', '.join(sorted(CONFIGS))})")
+    cfg, seq = args.model, args.seq
     if args.unroll:
         # children inherit os.environ through run_config
         os.environ["TORCHFT_TPU_SCAN_UNROLL"] = str(args.unroll)
 
     def _unroll_tag() -> str:
-        # seq/unroll are run-scoped, not cell-scoped — they must still be
-        # in every label or archived sweep lines from different runs are
-        # indistinguishable
-        return f" unroll={args.unroll}" if args.unroll else ""
+        # model/seq/unroll are run-scoped, not cell-scoped — they must
+        # still be in every label or archived sweep lines from different
+        # runs are indistinguishable
+        tag = f" unroll={args.unroll}" if args.unroll else ""
+        if args.model != "bench_350m":
+            tag += f" model={args.model}"
+        return tag
     attn = os.environ.get("TORCHFT_TPU_ATTENTION", "auto")
 
     if cell_specs:

@@ -78,6 +78,17 @@ CONFIGS: Dict[str, LlamaConfig] = {
         vocab_size=32000, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
         ffn_hidden=2816, max_seq_len=2048,
     ),
+    # ~1.07B params: the round-5 FLAGSHIP bench config (dim 2048 tiles the
+    # 128x128 MXU 16-wide; ffn matmuls are 2048x5632; measured 0.516 MFU vs
+    # the 350M config's 0.458 plateau, which this proved to be small-matmul
+    # overhead rather than a bandwidth floor - docs/performance.md).
+    # Pure-bf16 adamw state is ~6.0 GiB of 16 GiB HBM. bench.py headlines
+    # this config and re-measures bench_350m on the same artifact line so
+    # rounds <=4 stay directly comparable.
+    "bench_1b": LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=20, n_heads=16, n_kv_heads=8,
+        ffn_hidden=5632, max_seq_len=2048,
+    ),
     # Llama-3-8B (reference target config, examples/slurm/runner.py)
     "llama3_8b": LlamaConfig(
         vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,

@@ -3,10 +3,11 @@ accelerator. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 On a real TPU chip it times the bf16 adamw train step of a ~1.07B-param
-Llama (`bench_1b`, 0.516 MFU measured round 5 — the dim-2048 matmuls tile
-the MXU 16-wide; ~6 GiB adamw state leaves compile headroom on a 16 GiB
-v5e; the Llama-3-8B HSDP target shards this same code over a pod — see
-BASELINE.md), then re-measures the rounds-<=4 ~349M config into
+Llama (`bench_1b` at batch 4 — the measured peak of the round-5
+model/batch matrix, 0.533 MFU; the dim-2048 matmuls tile the MXU
+16-wide; ~6 GiB adamw state leaves compile headroom on a 16 GiB v5e;
+the Llama-3-8B HSDP target shards this same code over a pod — see
+BASELINE.md), then re-measures the rounds-<=4 ~349M batch-8 config into
 `bench_350m_*` fields on the same line for cross-round continuity.
 The reference publishes no benchmark numbers (BASELINE.md), so
 vs_baseline is reported against the theoretical-peak-based MFU denominator:
@@ -231,13 +232,17 @@ def main() -> None:
     from torchft_tpu.models.llama import CONFIGS
 
     if on_tpu:
-        # flagship: the ~1.07B config measured 0.516 MFU (round-5 sweep) —
-        # dim-2048 matmuls tile the MXU 16-wide, proving the 350M config's
-        # 0.458 plateau was small-matmul overhead, not a bandwidth floor.
-        # The 350M cell is re-measured below into bench_350m_* fields so
-        # rounds <=4 stay directly comparable (docs/performance.md).
+        # flagship: the ~1.07B config at batch 4 — the measured peak of the
+        # round-5 model/batch matrix (0.533 MFU; dim-2048 matmuls tile the
+        # MXU 16-wide, and the batch curve is inverted because remat-full
+        # recompute + activation traffic scale with batch while weight/
+        # optimizer traffic doesn't; the 1.49B config plateaus at the same
+        # ~0.534 with fewer tok/s — docs/performance.md). Proves the 350M
+        # config's 0.458 plateau was small-matmul overhead, not a
+        # bandwidth floor. The 350M cell is re-measured below into
+        # bench_350m_* fields so rounds <=4 stay directly comparable.
         cfg = CONFIGS["bench_1b"]
-        batch, seq, steps = 8, 2048, 10
+        batch, seq, steps = 4, 2048, 10
     else:
         cfg = CONFIGS["tiny"]
         batch, seq, steps = 4, 256, 3
@@ -320,9 +325,10 @@ def main() -> None:
         try:
             # TORCHFT_TPU_ATTENTION still holds the winning requested mode
             # from the fallback loop above, so the continuity row runs the
-            # same kernel as the flagship
+            # same kernel as the flagship. Batch stays pinned at 8 — the
+            # rounds-<=4 headline cell — independent of the flagship's.
             tps_350m, mfu_350m = timed_train_step(
-                CONFIGS["bench_350m"], batch, seq, steps
+                CONFIGS["bench_350m"], 8, seq, steps
             )
             record["bench_350m_tok_s"] = round(tps_350m, 1)
             record["bench_350m_mfu"] = round(mfu_350m, 4)

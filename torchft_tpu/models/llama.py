@@ -79,15 +79,24 @@ CONFIGS: Dict[str, LlamaConfig] = {
         ffn_hidden=2816, max_seq_len=2048,
     ),
     # ~1.07B params: the round-5 FLAGSHIP bench config (dim 2048 tiles the
-    # 128x128 MXU 16-wide; ffn matmuls are 2048x5632; measured 0.516 MFU vs
-    # the 350M config's 0.458 plateau, which this proved to be small-matmul
-    # overhead rather than a bandwidth floor - docs/performance.md).
-    # Pure-bf16 adamw state is ~6.0 GiB of 16 GiB HBM. bench.py headlines
-    # this config and re-measures bench_350m on the same artifact line so
-    # rounds <=4 stay directly comparable.
+    # 128x128 MXU 16-wide; ffn matmuls are 2048x5632; 0.533 MFU at batch 4,
+    # the measured peak of the model/batch matrix, vs the 350M config's
+    # 0.458 plateau - small-matmul overhead, not a bandwidth floor, see
+    # docs/performance.md). Pure-bf16 adamw state is ~6.0 GiB of 16 GiB
+    # HBM. bench.py headlines this config at batch 4 and re-measures
+    # bench_350m at batch 8 on the same artifact line so rounds <=4 stay
+    # directly comparable.
     "bench_1b": LlamaConfig(
         vocab_size=32000, dim=2048, n_layers=20, n_heads=16, n_kv_heads=8,
         ffn_hidden=5632, max_seq_len=2048,
+    ),
+    # ~1.49B params: the next MXU-width step (dim 2560 = 20 tiles of 128;
+    # ffn matmuls 2560x7040). ~8.3 GiB pure-bf16 adamw state. Probes
+    # whether the matmul-amortization gain continues past bench_1b on a
+    # single 16 GiB chip (docs/performance.md scaling curve).
+    "bench_2b": LlamaConfig(
+        vocab_size=32000, dim=2560, n_layers=18, n_heads=20, n_kv_heads=10,
+        ffn_hidden=7040, max_seq_len=2048,
     ),
     # Llama-3-8B (reference target config, examples/slurm/runner.py)
     "llama3_8b": LlamaConfig(

@@ -12,6 +12,15 @@
 # timeout; the sweep re-probes the backend after any timeout and stops if
 # the platform plugin has wedged.
 #
+# STATUS (round-5 continuation session): items 1-3 EXECUTED — results in
+# docs/performance.md (uniform block 1024 wins the ladder; unroll-2 and
+# every asymmetric tile lose; seq-8192 rows recorded). Item 4 was
+# deliberately SKIPPED: a hang ends in a timeout kill (the wedge
+# trigger) and the q2048 ladder cells already supplied the exact status
+# code the item was after. The same session also ran the model/batch
+# matrix (bench_1b/bench_2b) that produced the 0.538-MFU flagship —
+# this file remains as the wedge-policy template for future queues.
+#
 # Queue (round-4 leftovers, docs/performance.md "queued experiments"):
 #   1. splash block ladder incl. asymmetric q/kv tiles
 #   2. --unroll 2 variant of the headline cell

@@ -241,11 +241,12 @@ def main() -> None:
         # config's 0.458 plateau was small-matmul overhead, not a
         # bandwidth floor. The 350M cell is re-measured below into
         # bench_350m_* fields so rounds <=4 stay directly comparable.
-        cfg = CONFIGS["bench_1b"]
+        cfg_name = "bench_1b"
         batch, seq, steps = 4, 2048, 10
     else:
-        cfg = CONFIGS["tiny"]
+        cfg_name = "tiny"
         batch, seq, steps = 4, 256, 3
+    cfg = CONFIGS[cfg_name]
 
     # attention-kernel fallback chain: the bench must survive a Pallas
     # kernel regressing on new hardware/toolchains — a slower number beats
@@ -304,6 +305,11 @@ def main() -> None:
         # both timing windows (tok/s): value is the max; the spread is the
         # 1-vCPU host's scheduler, kept visible rather than averaged in
         "windows_tok_s": [round(w, 1) for w in windows],
+        # self-describing config — cross-round tooling must not have to
+        # parse the metric string
+        "model": cfg_name,
+        "batch": batch,
+        "seq": seq,
     }
     # peak_bytes_in_use is process-lifetime: a failed earlier attention mode
     # that allocated before dying would inflate it, so only record the peak

@@ -141,6 +141,14 @@ def main():
             ap.error(f"--cell {spec!r}: BATCH and CHUNK must be integers")
         cell_specs.append((parts[0], batch, chunk, len(parts) > 3))
 
+    # same pre-probe rule for --model: importing CONFIGS imports jax but
+    # initializes no backend, so a typo still fails in milliseconds
+    from torchft_tpu.models.llama import CONFIGS
+
+    if args.model not in CONFIGS:
+        ap.error(f"--model {args.model!r}: not in CONFIGS "
+                 f"({', '.join(sorted(CONFIGS))})")
+
     # share one persistent compilation cache with every child: a re-run of
     # the sweep (or the bench after it) replays cached executables instead
     # of re-risking tunnel-wedging compiles. Sets JAX_COMPILATION_CACHE_DIR
@@ -158,11 +166,6 @@ def main():
                  "bench_350m config would grind for hours on CPU (use "
                  "bench.py, which falls back to tiny).")
 
-    from torchft_tpu.models.llama import CONFIGS
-
-    if args.model not in CONFIGS:
-        sys.exit(f"--model {args.model!r}: not in CONFIGS "
-                 f"({', '.join(sorted(CONFIGS))})")
     cfg, seq = args.model, args.seq
     if args.unroll:
         # children inherit os.environ through run_config

@@ -353,7 +353,13 @@ def demo(args) -> None:
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="tiny", help="debug|tiny|llama3_8b|llama3_70b")
+    # choices from CONFIGS itself: the list can't drift when configs are
+    # added, and a typo dies at argparse instead of as a KeyError in every
+    # spawned replica (importing CONFIGS imports jax but no backend init)
+    from torchft_tpu.models.llama import CONFIGS
+
+    parser.add_argument("--config", default="tiny", choices=sorted(CONFIGS),
+                        help="model config (CONFIGS key)")
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--batch-size", type=int, default=4)
     parser.add_argument("--seq-len", type=int, default=128)

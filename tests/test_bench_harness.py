@@ -16,6 +16,26 @@ pytestmark = pytest.mark.slow  # compiles a (tiny) train step
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_mfu_sweep_model_typo_fails_before_probe():
+    """A --model typo must cost an argparse error in milliseconds, never
+    a 90 s backend probe against a possibly-wedged tunnel (the same
+    pre-probe rule the sweep's --cell validation follows)."""
+    import subprocess
+    import time
+
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "mfu_sweep.py"),
+         "--model", "bogus", "--cell", "full,8,0"],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+    )
+    assert out.returncode == 2, out.stderr[-500:]  # argparse error exit
+    assert "not in CONFIGS" in out.stderr
+    # generous bound: interpreter + jax import, but no 90 s probe
+    assert time.monotonic() - t0 < 45
+
+
 def test_timed_train_step_windows_contract():
     sys.path.insert(0, REPO)
     os.environ.setdefault("TORCHFT_TPU_ATTENTION", "auto")

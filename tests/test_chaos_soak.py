@@ -112,6 +112,15 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
     heal_count = [0]
     fleet_max_step = [0]
     mono_lock = threading.Lock()
+    # forensics: every commit as (incarnation, step, avg fingerprint,
+    # params-after fingerprint) per replica — the chaos interleaving is
+    # wall-clock-dependent, so a divergence may not reproduce from its
+    # seed; the histories must tell the story of THIS run (which step
+    # first disagreed, and whether via a different average or a bad heal)
+    commit_log: dict = {r: [] for r in range(n_replicas)}
+    # set once every replica has recorded finals: finished replicas DRAIN
+    # (keep participating) until then — see the drain loop in replica()
+    fleet_done = threading.Event()
 
     def note_commit(rid: int, step: int, incarnation_last: int) -> None:
         assert step > incarnation_last, (
@@ -129,7 +138,9 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
     def replica(rid: int) -> None:
         data_rng = np.random.RandomState(300 + rid)
         grad_base = data_rng.randn(8).astype(np.float32)
+        incarnation = 0
         while True:
+            incarnation += 1
             params = {"w": np.zeros(8, np.float32)}
 
             def load(sd, params=params):
@@ -161,12 +172,32 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
             alive[rid].set()
             died = False
             incarnation_last = manager.current_step()
+            zero = np.zeros(8, np.float32)
+            zgrads = {"w": jnp.asarray(zero) if plane == "device" else zero}
             try:
                 while manager.current_step() < target:
                     if kill_flags[rid].is_set():
                         kill_flags[rid].clear()
                         raise _Killed()
                     manager.start_quorum()
+                    if manager.current_step() >= target:
+                        # healed straight to completion (its commit failed
+                        # on the final step, or it restarted late, and a
+                        # finished peer in the drain served final state).
+                        # Finish the quorum it just joined with one
+                        # zero-grad drain step rather than abandoning it
+                        # (peers' in-flight collective must not wait on a
+                        # vanished participant), and only exit once the
+                        # commit confirms — on the async-quorum plane the
+                        # pending healed state is applied inside
+                        # should_commit, so breaking before it would
+                        # record pre-heal params as finals; a False vote
+                        # means the heal itself failed, so retry on the
+                        # next quorum
+                        manager.allreduce(zgrads).get_future().wait(30)
+                        if manager.should_commit():
+                            break
+                        continue
                     step = manager.current_step()
                     g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
                     grads = {"w": jnp.asarray(g) if plane == "device" else g}
@@ -181,6 +212,11 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
                         params["w"] = (
                             params["w"] - LR * np.asarray(avg["w"])
                         ).astype(np.float32)
+                        commit_log[rid].append(
+                            (incarnation, committed,
+                             float(np.asarray(avg["w"], np.float64).sum()),
+                             float(params["w"].astype(np.float64).sum()))
+                        )
                     if manager.last_quorum_healed():
                         with mono_lock:
                             heal_count[0] += 1
@@ -189,6 +225,30 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
                 # this ghost and condemn the last real runner to a solo
                 # replay that diverges
                 alive[rid].clear()
+                with mono_lock:
+                    if len(finals) == n_replicas:
+                        fleet_done.set()
+                # DRAIN until the whole fleet is done: keep participating
+                # in quorums (zero-gradient steps, no update applied) so a
+                # straggler whose final-step commit failed heals from this
+                # replica's final state instead of re-running the step in a
+                # solo quorum with only its own gradient — the endgame
+                # divergence a fresh-seed burn actually caught (a quiet-run
+                # device-plane error voted one replica's last commit False;
+                # its peers finished and left; it solo-replayed and ended
+                # bitwise-different). Production launchers drain the same
+                # way: the job is not torn down replica-by-replica while a
+                # peer may still need healing. A kill flag delivered in the
+                # alive->drain transition window is SWALLOWED, not honored:
+                # this replica's finals already count toward fleet_done, so
+                # restarting it would let the fleet tear down while its
+                # fresh incarnation solo-replays from step 0.
+                while not fleet_done.is_set():
+                    if kill_flags[rid].is_set():
+                        kill_flags[rid].clear()
+                    manager.start_quorum()
+                    manager.allreduce(zgrads).get_future().wait(30)
+                    manager.should_commit()
                 return
             except _Killed:
                 died = True
@@ -232,10 +292,20 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
 
     label = f"{plane}/{transport_kind}/{mode}"
     assert set(finals) == set(range(n_replicas)), (label, finals.keys())
+
+    def _histories() -> str:
+        lines = []
+        for r in range(n_replicas):
+            lines.append(f"replica {r} commits (incarnation, step, "
+                         f"sum(avg), sum(params_after)):")
+            lines.extend(f"  {entry}" for entry in commit_log[r])
+        return "\n".join(lines)
+
     for rid in range(1, n_replicas):
         np.testing.assert_array_equal(
             finals[0], finals[rid],
-            err_msg=f"{label}: replica {rid} diverged from replica 0",
+            err_msg=(f"{label}: replica {rid} diverged from replica 0\n"
+                     + _histories()),
         )
     assert np.isfinite(finals[0]).all(), label
     assert fleet_max_step[0] >= target, (label, fleet_max_step[0])

@@ -975,6 +975,18 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
+        if not local_should_commit:
+            # a false local vote silently discards the whole group's step —
+            # at WARNING so the reason is visible under default logging
+            # (INFO-only reasons made a spurious device-plane error during
+            # a quiet chaos soak undiagnosable from its console log)
+            self._logger.warning(
+                f"voting False for step {self._step}: "
+                f"enough_replicas={enough_replicas} "
+                f"(participants={self.num_participants()} "
+                f"min={self._min_replica_size}) "
+                f"errored={self._errored!r}"
+            )
         should_commit = self._client.should_commit(
             self._group_rank,
             self._step,

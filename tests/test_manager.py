@@ -549,6 +549,26 @@ class TestErrors:
         assert not m.should_commit()
         assert m.current_step() == 0
 
+    def test_false_local_vote_logs_reason_at_warning(self, caplog):
+        """A False local vote silently discards the whole group's step;
+        the REASON must be visible under default logging (a spurious
+        device-plane error during a quiet chaos soak was undiagnosable
+        from its console log when the reason logged at INFO only)."""
+        import logging
+
+        pg = MagicMock(wraps=ProcessGroupDummy())
+        pg.errored.return_value = None
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.report_error(RuntimeError("injected device-plane fault"))
+        with caplog.at_level(logging.WARNING):
+            assert not m.should_commit()
+        warnings = [r for r in caplog.records
+                    if r.levelno == logging.WARNING
+                    and "voting False" in r.getMessage()]
+        assert warnings, "no WARNING explaining the False local vote"
+        assert "injected device-plane fault" in warnings[0].getMessage()
+
     def test_errored_fast_path_skips_collective(self):
         pg = MagicMock(wraps=ProcessGroupDummy())
         pg.errored.return_value = None

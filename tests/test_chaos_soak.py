@@ -32,7 +32,7 @@ pytestmark = pytest.mark.slow  # randomized multi-replica soak
 
 from torchft_tpu.coordination import LighthouseServer
 from torchft_tpu.manager import Manager
-from torchft_tpu.process_group import ProcessGroupHost
+from torchft_tpu.process_group import ProcessGroupHost, ReduceOp
 
 N_REPLICAS = 3
 TARGET_STEPS = 30
@@ -86,6 +86,123 @@ def test_extended_mixed_soak():
     rng = random.Random(0x50AC)
     for phase in SOAK_PHASES:
         _run_soak_phase(rng, *phase)
+
+
+@pytest.mark.slow
+def test_slow_rendezvous_timeout_discards_step_then_heals(caplog):
+    """Deterministic replay of the failure chain a fresh-seed burn caught
+    (docs/operations.md "teardown must drain"): one replica's per-op
+    deadline fires while a peer's contribution to the local-mode slot
+    rendezvous is stalled (the microVM-scheduler-stall hypothesis), so it
+    records an error, votes False with the WARNING, falls one step
+    behind, HEALS from the committed peer on the next quorum, and the
+    fleet still converges bitwise thanks to the endgame drain."""
+    import logging
+
+    import jax.numpy as jnp
+
+    from torchft_tpu.process_group_xla import ProcessGroupXLA
+
+    target = 6
+    stall_step = 3
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800,
+    )
+    finals: dict = {}
+    fleet_done = threading.Event()
+    healed = threading.Event()
+
+    class _StallOncePG(ProcessGroupXLA):
+        """Delays this rank's deposit once, at the chosen step's
+        allreduce — the other rank's shorter deadline fires mid-wait."""
+
+        def __init__(self) -> None:
+            super().__init__(timeout=30.0, mode="local")
+            self.calls = 0
+
+        def allreduce(self, arrays, op=ReduceOp.SUM):
+            self.calls += 1
+            if self.calls == stall_step:
+                time.sleep(6.0)
+            return super().allreduce(arrays, op)
+
+    def replica(rid: int) -> None:
+        grad_base = np.random.RandomState(300 + rid).randn(8).astype(
+            np.float32
+        )
+        params = {"w": np.zeros(8, np.float32)}
+
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        manager = Manager(
+            pg=_StallOncePG() if rid == 0
+            else ProcessGroupXLA(timeout=30.0, mode="local"),
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=1,
+            use_async_quorum=False,
+            replica_id=f"stall_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            # the victim's per-op deadline is shorter than the stall; the
+            # staller's own budget comfortably covers it
+            timeout=3.0 if rid == 1 else 30.0,
+            quorum_timeout=30.0,
+        )
+        zgrads = {"w": jnp.zeros(8, jnp.float32)}
+        try:
+            while manager.current_step() < target:
+                manager.start_quorum()
+                if manager.last_quorum_healed():
+                    # checked on EVERY path out of start_quorum: a heal
+                    # can land the replica straight at >= target (e.g.
+                    # when a slow CI host let the peer advance solo) and
+                    # must still count for the hard assert below
+                    healed.set()
+                if manager.current_step() >= target:
+                    manager.allreduce(zgrads).get_future().wait(60)
+                    if manager.should_commit():
+                        break
+                    continue
+                step = manager.current_step()
+                g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+                avg = manager.allreduce(
+                    {"w": jnp.asarray(g)}
+                ).get_future().wait(60)
+                if manager.should_commit():
+                    params["w"] = (
+                        params["w"] - LR * np.asarray(avg["w"])
+                    ).astype(np.float32)
+            finals[rid] = params["w"].copy()
+            if len(finals) == 2:
+                fleet_done.set()
+            while not fleet_done.is_set():
+                manager.start_quorum()
+                manager.allreduce(zgrads).get_future().wait(60)
+                manager.should_commit()
+        finally:
+            manager.shutdown(wait=False)
+
+    ex = ThreadPoolExecutor(max_workers=2)
+    try:
+        with caplog.at_level(logging.WARNING, logger="torchft_tpu.manager"):
+            futs = [ex.submit(replica, r) for r in range(2)]
+            for f in futs:
+                f.result(timeout=180)
+    finally:
+        fleet_done.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+
+    warned = any("voting False" in r.getMessage() for r in caplog.records)
+    assert warned, "the False vote never logged its WARNING"
+    assert healed.is_set(), "the timed-out replica never live-healed"
+    np.testing.assert_array_equal(
+        finals[0], finals[1],
+        err_msg="replicas diverged after the injected rendezvous stall",
+    )
+    assert np.isfinite(finals[0]).all()
 
 
 def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,

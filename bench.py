@@ -342,35 +342,45 @@ def main() -> None:
             record["bench_350m_error"] = str(e)[:200]
 
     # FT metrics ride the same line; a failure here must never cost the
-    # headline number. Host plane at the legacy 8 MB payload (comparable to
+    # headline number, and each row gets ONE retry: the rows run in fresh
+    # subprocesses, and the CPU runtime has a rare (~1-in-6 observed at the
+    # 1 GB row) teardown abort in its Eigen threadpool — a flake worth one
+    # more attempt in the driver's single artifact run, not worth losing
+    # the row to. Host plane at the legacy 8 MB payload (comparable to
     # round<=3 artifacts), device plane at 256 MB (VERDICT round-3 item 4:
     # recovery cost where the collective payload is ProcessGroupXLA's).
-    try:
-        record.update(fault_tolerance_metrics())
-    except Exception as e:  # noqa: BLE001
-        record["ft_error"] = str(e)[:200]
-    try:
-        record.update(
-            fault_tolerance_metrics(size_mb=256, steps=10, kill_at=3,
-                                    plane="device")
-        )
-    except Exception as e:  # noqa: BLE001
-        record["ft_device_error"] = str(e)[:200]
+    import subprocess
+
+    def ft_row(error_key, **kw):
+        for attempt in (1, 2):
+            try:
+                record.update(fault_tolerance_metrics(**kw))
+                if error_key in record:
+                    # recovered on retry: keep the first failure as a
+                    # breadcrumb so the flake rate stays trackable across
+                    # artifact runs instead of vanishing into a clean row
+                    record[error_key + "_retried"] = record.pop(error_key)
+                return
+            except subprocess.TimeoutExpired as e:
+                # a genuine hang already cost the row's full wall-clock
+                # budget — retrying a wedged child doubles a ~20 min wait
+                # for a failure mode the retry was never aimed at
+                record[error_key] = f"attempt {attempt}: {str(e)[:200]}"
+                return
+            except Exception as e:  # noqa: BLE001
+                record[error_key] = f"attempt {attempt}: {str(e)[:200]}"
+
+    ft_row("ft_error")
+    ft_row("ft_device_error", size_mb=256, steps=10, kill_at=3,
+           plane="device")
     # >=1 GB device-payload heal with the detection/configure/heal split,
     # over the in-place PG transport (the fast path): the at-scale recovery
     # row (VERDICT round-4 item 5)
-    try:
-        record.update(
-            fault_tolerance_metrics(size_mb=1024, steps=8, kill_at=2,
-                                    plane="device", transport="pg-inplace",
-                                    prefix="ft_device_1g_",
-                                    # GB-scale steps on a loaded 1-vCPU
-                                    # host: a 3 s timeout would abort slow
-                                    # first-touch rounds, not real hangs
-                                    collective_timeout=15.0)
-        )
-    except Exception as e:  # noqa: BLE001
-        record["ft_device_1g_error"] = str(e)[:200]
+    ft_row("ft_device_1g_error", size_mb=1024, steps=8, kill_at=2,
+           plane="device", transport="pg-inplace", prefix="ft_device_1g_",
+           # GB-scale steps on a loaded 1-vCPU host: a 3 s timeout would
+           # abort slow first-touch rounds, not real hangs
+           collective_timeout=15.0)
 
     print(json.dumps(record))
 

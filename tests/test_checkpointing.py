@@ -182,9 +182,11 @@ class TestHTTPRestageAtomicity:
                 body += got
             s.close()
 
-            frame = struct.Struct("<qq")
-            leaf_idx, nbytes = frame.unpack(body[: frame.size])
+            # v2 wire frame: leaf_idx, offset, nbytes (byte range)
+            frame = struct.Struct("<qqq")
+            leaf_idx, off, nbytes = frame.unpack(body[: frame.size])
             assert leaf_idx == 0
+            assert off == 0
             payload = np.frombuffer(
                 body[frame.size: frame.size + nbytes], np.float32
             )
@@ -737,6 +739,139 @@ class TestStreamingPaths:
             sent = pgs[0]._gen.comm.bytes_sent
             payload = 40_000 * 4 + 50_000 * 2
             assert sent < payload * 1.5, (sent, payload)
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()
+
+
+class TestChunkedStreaming:
+    """Byte-range chunking: a single huge leaf splits across >2 wire chunks
+    on both transports, recovers bitwise-identical, reports per-stream
+    timings, and aborts cleanly on a corrupted mid-stream plan."""
+
+    def test_plan_wire_ranges_splits_single_large_leaf(self):
+        from torchft_tpu.checkpointing.transport import plan_wire_ranges
+
+        plan = plan_wire_ranges([100], 30)
+        assert [r for c in plan for r in c] == [
+            (0, 0, 30), (0, 30, 30), (0, 60, 30), (0, 90, 10)
+        ]
+        # multi-leaf packing; zero-byte leaves still ride as a range so the
+        # receiver can finalize them
+        plan = plan_wire_ranges([10, 0, 25], 16)
+        flat = [r for c in plan for r in c]
+        assert (1, 0, 0) in flat
+        covered = {}
+        for j, off, ln in flat:
+            covered[j] = covered.get(j, 0) + ln
+        assert covered[0] == 10 and covered[2] == 25
+
+    def test_http_single_leaf_multi_chunk_bitwise_equal(self):
+        # one 1 MiB leaf forced into 4 chunks — leaf-granularity chunking
+        # could never split this
+        state = {"params": {"w": np.arange(262_144, dtype=np.float32)}}
+        src = HTTPTransport(timeout=10.0, num_chunks=4)
+        dst = HTTPTransport(timeout=10.0)
+        try:
+            src.send_checkpoint([1], 7, state, 10.0)
+            out = dst.recv_checkpoint(0, src.metadata(), 7, 10.0)
+            np.testing.assert_array_equal(out["params"]["w"], state["params"]["w"])
+            stats = dst.last_recv_timings()
+            assert stats is not None and stats.num_chunks > 2
+            assert stats.total_bytes == state["params"]["w"].nbytes
+            assert stats.mb_per_s > 0
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+    def test_http_mid_stream_corruption_aborts(self):
+        """A wire plan whose ranges overlap (duplicate chunk served twice)
+        must abort the recv with an error — never return torn state."""
+        state = {"w": np.arange(262_144, dtype=np.float32)}
+        src = HTTPTransport(timeout=5.0, num_chunks=4)
+        dst = HTTPTransport(timeout=5.0)
+        try:
+            src.send_checkpoint([1], 7, state, 5.0)
+            step, spec, payloads, assignments = src._staged
+            src._staged = (step, spec, payloads, [assignments[0]] * 2)
+            with pytest.raises((ConnectionError, OSError, RuntimeError)):
+                dst.recv_checkpoint(0, src.metadata(), 7, 5.0)
+        finally:
+            src.shutdown()
+            dst.shutdown()
+
+    def test_pg_ranged_single_leaf_multi_chunk_bitwise_equal(self, monkeypatch):
+        # shrink the chunk knob so a 1 MiB leaf pipelines as 16 ranged
+        # chunks over the host PG (recv_into path)
+        monkeypatch.setenv("TORCHFT_STREAM_CHUNK_BYTES", str(64 * 1024))
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=10.0) for _ in range(2)]
+        try:
+            addr = f"127.0.0.1:{store.port}/rangedckpt"
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(lambda r: pgs[r].configure(addr, r, 2, 21), range(2)))
+            state = {"params": {"w": np.arange(262_144, dtype=np.float32)}}
+            sender = PGTransport(pgs[0], timeout=10.0)
+            receiver = PGTransport(pgs[1], timeout=10.0)
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fs = ex.submit(sender.send_checkpoint, [1], 6, state, 10.0)
+                fr = ex.submit(receiver.recv_checkpoint, 0, "<pg_transport>", 6, 10.0)
+                fs.result(timeout=30)
+                out = fr.result(timeout=30)
+            np.testing.assert_array_equal(out["params"]["w"], state["params"]["w"])
+            stats = receiver.last_recv_timings()
+            assert stats is not None and stats.num_chunks > 2
+            assert stats.total_bytes == state["params"]["w"].nbytes
+        finally:
+            for pg in pgs:
+                pg.shutdown()
+            store.shutdown()
+
+    def test_pg_ranged_mid_stream_sender_death_aborts(self):
+        """Sender dies after the first ranged chunk: the pipelined receiver
+        must surface an error within its timeout, not hang or return torn
+        state."""
+        import pickle
+
+        from torchft_tpu.checkpointing._serialization import (
+            flatten_state,
+            payload_memoryview,
+        )
+        from torchft_tpu.checkpointing.transport import plan_wire_ranges
+
+        store = KvStoreServer("127.0.0.1:0")
+        pgs = [ProcessGroupHost(timeout=3.0) for _ in range(2)]
+        try:
+            addr = f"127.0.0.1:{store.port}/deadckpt"
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                list(ex.map(lambda r: pgs[r].configure(addr, r, 2, 23), range(2)))
+            state = {"w": np.arange(262_144, dtype=np.float32)}
+            spec, payloads = flatten_state(state)
+            wire = payload_memoryview(payloads[0])
+            ranges = plan_wire_ranges([len(wire)], 64 * 1024)
+            header = pickle.dumps((6, spec, "ranged", ranges))
+
+            def half_send():
+                # the real wire: header on tag=1, chunk payloads on tag=2
+                pgs[0].send(
+                    [np.frombuffer(header, np.uint8)], 1, tag=1
+                ).wait(timeout=5.0)
+                j, off, ln = ranges[0][0]
+                pgs[0].send(
+                    [np.frombuffer(wire[off : off + ln], np.uint8)], 1, tag=2
+                ).wait(timeout=5.0)
+                # ...and nothing more: chunks 2..N never arrive
+
+            receiver = PGTransport(pgs[1], timeout=3.0)
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fs = ex.submit(half_send)
+                fr = ex.submit(
+                    receiver.recv_checkpoint, 0, "<pg_transport>", 6, 3.0
+                )
+                fs.result(timeout=10)
+                with pytest.raises(Exception):
+                    fr.result(timeout=30)
         finally:
             for pg in pgs:
                 pg.shutdown()

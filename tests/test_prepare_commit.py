@@ -1,0 +1,233 @@
+"""Prepare/commit configure split (overlapped quorum on the device plane).
+
+The Manager runs ``prepare_configure`` on the quorum executor thread and
+applies the returned commit from the main thread at the next safe point
+(start_quorum / allreduce / should_commit). These tests pin down the
+thread placement, the safe-point ordering, the failure path, and the
+deterministic no-race guarantee when a quorum lands while a jitted step
+is in flight.
+"""
+
+import threading
+from unittest.mock import MagicMock, patch
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.test_manager import make_manager, make_quorum
+from torchft_tpu._test.event_injector import EventInjector
+from torchft_tpu.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+)
+from torchft_tpu.process_group_xla import ProcessGroupXLA
+
+
+class SplitPG(ProcessGroupDummy):
+    """Dummy PG with a real prepare/commit split that records which thread
+    ran each phase."""
+
+    def __init__(self, fail_commits: int = 0) -> None:
+        super().__init__()
+        self.prepare_threads = []
+        self.commit_threads = []
+        self.commit_count = 0
+        self.fail_commits = fail_commits
+
+    def prepare_configure(
+        self, store_addr, replica_rank, replica_world_size, quorum_id=0
+    ):
+        self.prepare_threads.append(threading.current_thread().name)
+
+        def commit():
+            self.commit_threads.append(threading.current_thread().name)
+            if self.fail_commits > 0:
+                self.fail_commits -= 1
+                raise RuntimeError("injected commit failure")
+            self.commit_count += 1
+            self.configure(
+                store_addr, replica_rank, replica_world_size, quorum_id=quorum_id
+            )
+
+        return commit
+
+
+class TestPrepareConfigureBase:
+    def test_base_prepare_routes_through_shadowed_configure(self):
+        """The default split must route through ``self.configure`` (the
+        instance attribute), so shadowing configure — recovery_bench's
+        ``_timed_configure``, test MagicMocks — still intercepts PGs
+        without their own split."""
+        pg = ProcessGroupDummy()
+        calls = []
+        orig = pg.configure
+        pg.configure = lambda *a, **k: (calls.append(a), orig(*a, **k))[-1]
+        assert pg.prepare_configure("s:1/x", 0, 1, quorum_id=2) is None
+        assert len(calls) == 1
+        assert pg.configure_count == 1
+
+    def test_error_swallow_clears_immediately_for_unsplit_pg(self):
+        wrapper = ErrorSwallowingProcessGroupWrapper(ProcessGroupDummy())
+        wrapper.report_error(RuntimeError("boom"))
+        assert wrapper.prepare_configure("s:1/x", 0, 1) is None
+        assert wrapper.errored() is None
+
+    def test_error_swallow_clears_at_commit_for_split_pg(self):
+        """For a split PG the swallowed-error state must survive prepare
+        (the old communicator is still the live one) and clear only when
+        the commit makes the new one live."""
+        inner = SplitPG()
+        wrapper = ErrorSwallowingProcessGroupWrapper(inner)
+        wrapper.report_error(RuntimeError("boom"))
+        commit = wrapper.prepare_configure("s:1/x", 0, 1, quorum_id=3)
+        assert commit is not None
+        assert wrapper.errored() is not None  # not yet: prepare only staged
+        commit()
+        assert wrapper.errored() is None
+        assert inner.commit_count == 1
+
+
+class TestManagerPrepareCommit:
+    def test_prepare_on_quorum_thread_commit_on_main(self):
+        pg = SplitPG()
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        # prepare already ran, on the quorum executor — commit is pending
+        assert len(pg.prepare_threads) == 1
+        assert pg.prepare_threads[0].startswith("torchft_quorum")
+        assert pg.commit_count == 0
+        assert m.should_commit()
+        # the swap landed on THIS thread, at the should_commit safe point
+        assert pg.commit_count == 1
+        assert pg.commit_threads == [threading.current_thread().name]
+        t = m.timings()
+        assert t["quorum_overlap_s"] > 0
+        assert "configure_prepare_s" in t
+        assert t["configure_commit_s"] >= 0
+
+    def test_unsplit_pg_records_zero_commit_time(self):
+        m = make_manager(quorum=make_quorum())  # ProcessGroupDummy: no split
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.should_commit()
+        assert m.timings()["configure_commit_s"] == 0.0
+
+    def test_allreduce_applies_pending_commit(self):
+        pg = SplitPG()
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.commit_count == 0
+        out = (
+            m.allreduce({"w": np.full((3,), 4.0, dtype=np.float32)})
+            .get_future()
+            .wait(timeout=10)
+        )
+        np.testing.assert_allclose(out["w"], 2.0)
+        assert pg.commit_count == 1
+
+    def test_steady_state_step_skips_reconfigure(self):
+        """A no-membership-change step must pay no prepare and no commit."""
+        pg = SplitPG()
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.should_commit()
+        assert (len(pg.prepare_threads), pg.commit_count) == (1, 1)
+        # same quorum_id again: the reconfigure block must not run at all
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.should_commit()
+        assert (len(pg.prepare_threads), pg.commit_count) == (1, 1)
+
+    def test_commit_failure_reports_error_and_forces_reconfigure(self):
+        pg = SplitPG(fail_commits=1)
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert not m.should_commit()  # commit raised -> local vote False
+        assert m._quorum_id == -1  # poisoned so the next quorum re-runs
+        m.start_quorum()
+        m.wait_quorum()
+        assert m.should_commit()
+        assert len(pg.prepare_threads) == 2
+        assert pg.commit_count == 1
+
+    def test_stalled_prepare_does_not_block_jitted_step(self):
+        """A quorum landing while a jitted step is in flight: the prepare
+        stalls on the quorum thread past the step boundary, the main
+        thread's compute completes untouched, and the backend swap is only
+        applied afterwards, at the next safe point."""
+        inner = SplitPG()
+        fake = FakeProcessGroupWrapper(inner)
+        injector = EventInjector().stall_prepare_at(0, 0)
+        fake.set_prepare_hook(lambda: injector.check_prepare(0, 0))
+        m = make_manager(pg=fake, quorum=make_quorum())
+        try:
+            m.start_quorum()
+            assert injector.wait_prepare_stalled(timeout=30)
+
+            # main thread crosses a full jitted step while prepare is stalled
+            step = jax.jit(lambda x: (x * 2.0).sum())
+            val = float(step(jnp.arange(8.0)))
+            assert val == 56.0
+            assert not m._quorum_future.done()  # still stalled
+            assert inner.commit_count == 0  # no swap raced the step
+        finally:
+            injector.release_prepare()
+
+        assert m.should_commit()
+        assert inner.commit_count == 1
+        assert inner.commit_threads == [threading.current_thread().name]
+        assert inner.prepare_threads[0].startswith("torchft_quorum")
+
+    def test_shutdown_drops_pending_commit(self):
+        pg = SplitPG()
+        m = make_manager(pg=pg, quorum=make_quorum())
+        m.start_quorum()
+        m.wait_quorum()
+        assert m._pending_pg_commit is not None
+        m.shutdown(wait=True)
+        assert m._pending_pg_commit is None
+        assert pg.commit_count == 0
+
+
+class TestXLAPrepareCommit:
+    def test_requires_sync_quorum_is_false(self):
+        """ProcessGroupXLA no longer forces the Manager's sync-quorum
+        safety valve — its configure is split instead."""
+        assert ProcessGroupXLA(mode="local").requires_sync_quorum is False
+
+    def test_manager_keeps_async_quorum_for_split_pg(self):
+        m = make_manager(pg=ProcessGroupXLA(mode="local"), use_async_quorum=True)
+        assert m._use_async_quorum is True
+
+    def test_distributed_prepare_defers_backend_swap(self):
+        """Distributed prepare does only KV rendezvous; the jax world swap
+        (retire + join + install) happens exclusively inside the commit."""
+        pg = ProcessGroupXLA(timeout=5.0, mode="distributed")
+        with (
+            patch("torchft_tpu.process_group_xla.KvClient") as kv_cls,
+            patch.object(ProcessGroupXLA, "_retire_current_world") as retire,
+            patch.object(ProcessGroupXLA, "_configure_distributed") as cfg,
+            patch.object(ProcessGroupXLA, "_install_world") as install,
+        ):
+            cfg.return_value = MagicMock()
+            commit = pg.prepare_configure("127.0.0.1:1/pgxla", 0, 2, quorum_id=3)
+            assert commit is not None
+            kv_cls.return_value.set.assert_called_once()  # rank 0 publishes
+            retire.assert_not_called()
+            cfg.assert_not_called()
+            install.assert_not_called()
+
+            commit()
+            retire.assert_called_once()
+            cfg.assert_called_once()
+            install.assert_called_once()
+            # the staged coordinator address flows into the backend join
+            (coord, rank, world, qid) = cfg.call_args.args
+            assert (rank, world, qid) == (0, 2, 3)

@@ -271,6 +271,7 @@ class TestLocalMode:
             is_participating = lambda self: True
             report_error = lambda self, e: None
             _bump_metric = lambda self, name: None
+            _commit_pending_configure = lambda self: None
 
             def wrap_future(self, fut, default, **kwargs):
                 return fut
@@ -314,6 +315,7 @@ class TestLocalMode:
             is_participating = lambda self: True
             report_error = lambda self, e: None
             _bump_metric = lambda self, name: None
+            _commit_pending_configure = lambda self: None
 
             def wrap_future(self, fut, default, **kwargs):
                 return fut

@@ -38,7 +38,52 @@ class EventInjector:
         self._lock = threading.Lock()
         self._events: Dict[Tuple[int, int], _Event] = {}
         self._barrier: Optional[threading.Barrier] = None
+        # stall-prepare gate (prepare/commit configure split tests): the
+        # quorum thread blocks inside prepare_configure until the test
+        # calls release_prepare(), proving the main thread's jitted step
+        # can cross a step boundary while the reconfigure is in flight
+        self._prepare_gate: Optional[threading.Event] = None
+        self._prepare_stalled = threading.Event()
+        self._stall_key: Optional[Tuple[int, int]] = None
         self.count = 0
+
+    def stall_prepare_at(self, replica: int, step: int) -> "EventInjector":
+        """Arm a one-shot stall: the (replica, step) prepare_configure
+        blocks on the quorum thread until ``release_prepare``. Wire it via
+        ``FakeProcessGroupWrapper.set_prepare_hook`` with a lambda calling
+        ``check_prepare(replica, mgr.current_step())``."""
+        with self._lock:
+            self._prepare_gate = threading.Event()
+            self._prepare_stalled.clear()
+            self._stall_key = (replica, step)
+        return self
+
+    def wait_prepare_stalled(self, timeout: float = 30.0) -> bool:
+        """Block until the armed prepare is actually inside its stall."""
+        return self._prepare_stalled.wait(timeout)
+
+    def release_prepare(self) -> None:
+        with self._lock:
+            gate, self._prepare_gate = self._prepare_gate, None
+            self._stall_key = None
+        if gate is not None:
+            gate.set()
+
+    def check_prepare(self, replica: int, step: int) -> None:
+        """Call from a prepare hook; blocks iff the stall is armed for this
+        (replica, step). Bounded wait so a test bug cannot hang the quorum
+        executor forever."""
+        with self._lock:
+            if self._stall_key != (replica, step):
+                return
+            gate = self._prepare_gate
+        if gate is not None:
+            self._prepare_stalled.set()
+            if not gate.wait(timeout=30.0):
+                raise RuntimeError(
+                    f"stalled prepare replica={replica} step={step} was "
+                    "never released"
+                )
 
     def fail_at(self, replica: int, step: int) -> "EventInjector":
         with self._lock:

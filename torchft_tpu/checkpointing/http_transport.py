@@ -8,11 +8,18 @@ chunks in parallel and reassemble the pytree.
 
 Both directions stream (reference `_streaming_save/_load`,
 http_transport.py:219-266): the sender serves leaf payloads straight from
-the staged host arrays — one [leaf_idx, nbytes] frame header then the raw
-buffer per leaf, no pre-pickled chunk bodies — and the receiver reads each
-frame directly into the leaf's final preallocated array (``readinto``).
-Peak host overhead is O(stream buffer), not O(payload), which is what makes
-12GB-class state dicts transferable at 8B scale.
+the staged host arrays — one [leaf_idx, offset, nbytes] frame header then
+the raw byte range, no pre-pickled chunk bodies — and the receiver reads
+each frame directly into the leaf's final preallocated array
+(``readinto``). Peak host overhead is O(stream buffer), not O(payload),
+which is what makes 12GB-class state dicts transferable at 8B scale.
+
+Wire chunks are BYTE ranges (``plan_wire_ranges``), not whole leaves: a
+single multi-GB fused parameter buffer splits across chunks, so parallel
+chunk fetches overlap its network transfer with the device placement of
+already-complete leaves instead of store-and-forwarding one blob. Wire
+version 2; v1 senders (whole-leaf ``[leaf_idx, nbytes]`` frames) are still
+understood on receive.
 """
 
 from __future__ import annotations
@@ -22,6 +29,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
@@ -36,17 +44,27 @@ from torchft_tpu.checkpointing._serialization import (
     flatten_state,
     payload_memoryview,
     place_leaf_like,
-    split_chunks,
     template_leaves_for,
     unflatten_state,
 )
-from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.checkpointing.transport import (
+    CheckpointTransport,
+    ChunkStat,
+    StreamTimings,
+    plan_wire_ranges,
+    stream_chunk_bytes,
+)
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["HTTPTransport"]
 
-_FRAME = struct.Struct("<qq")  # leaf_idx, nbytes
+_FRAME = struct.Struct("<qq")  # v1: leaf_idx, nbytes (whole leaf)
+_FRAME_V2 = struct.Struct("<qqq")  # leaf_idx, offset, nbytes (byte range)
+_WIRE_VERSION = 2
+# cap on auto-planned chunks (num_chunks=0): bounds fetch parallelism and
+# the per-chunk frame overhead on huge states
+_AUTO_MAX_CHUNKS = 8
 
 
 def _to_seconds(timeout: "float | timedelta") -> float:
@@ -56,7 +74,11 @@ def _to_seconds(timeout: "float | timedelta") -> float:
 class HTTPTransport(CheckpointTransport[Any]):
     """Serve checkpoints over HTTP; receive with parallel chunk fetch.
 
-    ``num_chunks=0`` serves everything as one chunk.
+    ``num_chunks=0`` auto-plans byte-range chunks of roughly
+    ``TORCHFT_STREAM_CHUNK_BYTES`` (default 32 MiB, at most 8 chunks), so
+    the default transport pipelines large heals; ``num_chunks>0`` forces
+    that many chunks. Chunk boundaries are byte offsets, not leaf
+    boundaries — one huge leaf still streams as multiple chunks.
 
     ``state_dict_template`` (zero-arg callable returning a pytree, same
     contract as PGTransport's) enables in-place receive: a matching host
@@ -190,12 +212,12 @@ class HTTPTransport(CheckpointTransport[Any]):
         """Write the response for ``what`` (True if the resource exists)
         from the captured ``staged`` snapshot.
 
-        Chunk bodies stream straight from the staged arrays: per leaf a
-        16-byte [leaf_idx, nbytes] frame then the raw buffer — never
-        assembled in memory."""
+        Chunk bodies stream straight from the staged arrays: per range a
+        24-byte [leaf_idx, offset, nbytes] frame then the raw byte range —
+        never assembled in memory."""
         _step, spec, payloads, assignments = staged
         if what == "metadata":
-            body = pickle.dumps((spec, len(assignments)))
+            body = pickle.dumps((spec, len(assignments), _WIRE_VERSION))
             handler.send_response(200)
             handler.send_header("Content-Type", "application/octet-stream")
             handler.send_header("Content-Length", str(len(body)))
@@ -206,18 +228,16 @@ class HTTPTransport(CheckpointTransport[Any]):
             i = int(what[len("chunk_"):])
             if not (0 <= i < len(assignments)):
                 return False
-            idxs = assignments[i]
-            total = sum(
-                _FRAME.size + spec.leaves[j].nbytes for j in idxs
-            )
+            ranges = assignments[i]
+            total = sum(_FRAME_V2.size + ln for (_j, _off, ln) in ranges)
             handler.send_response(200)
             handler.send_header("Content-Type", "application/octet-stream")
             handler.send_header("Content-Length", str(total))
             handler.end_headers()
-            for j in idxs:
+            for j, off, ln in ranges:
                 mv = payload_memoryview(payloads[j])
-                handler.wfile.write(_FRAME.pack(j, len(mv)))
-                handler.wfile.write(mv)
+                handler.wfile.write(_FRAME_V2.pack(j, off, ln))
+                handler.wfile.write(mv[off : off + ln])
             with self._fetch_cond:
                 # only count serves of the CURRENT staging: a stale-snapshot
                 # serve completing after a restage must not satisfy the new
@@ -243,8 +263,15 @@ class HTTPTransport(CheckpointTransport[Any]):
         ``disallow_checkpoint`` re-locks (reference: http_transport.py:219-241).
         """
         spec, payloads = flatten_state(state_dict)
-        num = self._num_chunks or 1
-        assignments = split_chunks([m.nbytes for m in spec.leaves], num)
+        leaf_nbytes = [m.nbytes for m in spec.leaves]
+        total = sum(leaf_nbytes)
+        if self._num_chunks > 0:
+            chunk_bytes = max(1, -(-total // self._num_chunks))
+        else:
+            chunk_bytes = stream_chunk_bytes()
+            if total > chunk_bytes * _AUTO_MAX_CHUNKS:
+                chunk_bytes = -(-total // _AUTO_MAX_CHUNKS)
+        assignments = plan_wire_ranges(leaf_nbytes, chunk_bytes)
         # single atomic swap: in-flight readers keep the old snapshot
         self._staged = (step, spec, payloads, assignments)
         with self._fetch_cond:
@@ -289,7 +316,10 @@ class HTTPTransport(CheckpointTransport[Any]):
             with urllib.request.urlopen(url, timeout=timeout_s) as r:
                 return r.read()
 
-        spec, num_chunks = pickle.loads(fetch(f"{base}/metadata"))
+        # tolerant unpack: v1 senders ship (spec, num_chunks), v2 appends
+        # the wire version — unknown trailing fields are ignored
+        spec, num_chunks, *meta_rest = pickle.loads(fetch(f"{base}/metadata"))
+        version = meta_rest[0] if meta_rest else 1
         payloads: List[Optional[Any]] = [None] * len(spec.leaves)
 
         template_leaves: Optional[List[Any]] = None
@@ -312,20 +342,88 @@ class HTTPTransport(CheckpointTransport[Any]):
                 return t
             return None
 
+        # Per-leaf reassembly: ranges of one leaf may arrive on different
+        # chunk-fetch threads, so the recv buffer is allocated once under a
+        # lock and a bytes-remaining counter triggers finalization (device
+        # placement / bytes conversion) exactly once, on the thread that
+        # lands the last range — placement of a completed leaf overlaps
+        # the wire transfer of the chunks still streaming.
+        buf_lock = threading.Lock()
+        buffers: List[Optional[Any]] = [None] * len(spec.leaves)
+        direct: List[bool] = [False] * len(spec.leaves)
+        remaining: List[int] = [m.nbytes for m in spec.leaves]
+
+        def _buffer_for(leaf_idx: int) -> Any:
+            with buf_lock:
+                if buffers[leaf_idx] is None:
+                    meta = spec.leaves[leaf_idx]
+                    if meta.kind == "array":
+                        target = _host_target(meta, leaf_idx)
+                        if target is not None:
+                            buffers[leaf_idx] = target
+                            direct[leaf_idx] = True
+                        else:
+                            buffers[leaf_idx] = alloc_leaf(meta)
+                    else:
+                        buffers[leaf_idx] = bytearray(meta.nbytes)
+                return buffers[leaf_idx]
+
+        def _mark_written(leaf_idx: int, n: int) -> bool:
+            """Credit ``n`` received bytes; True when the leaf is complete
+            (finalize on the calling thread, outside the lock)."""
+            with buf_lock:
+                remaining[leaf_idx] -= n
+                if remaining[leaf_idx] < 0:
+                    raise ConnectionError(
+                        f"leaf {leaf_idx}: overlapping/duplicate wire ranges"
+                    )
+                return remaining[leaf_idx] == 0 and payloads[leaf_idx] is None
+
+        def _finish_leaf(leaf_idx: int) -> None:
+            meta = spec.leaves[leaf_idx]
+            arr = buffers[leaf_idx]
+            if meta.kind == "array":
+                if not direct[leaf_idx] and template_leaves is not None:
+                    # device template (device_put) or a mismatch
+                    # (warns "in-place receive degraded")
+                    arr = place_leaf_like(arr, template_leaves[leaf_idx], logger)
+                payloads[leaf_idx] = arr
+            else:
+                payloads[leaf_idx] = bytes(arr)
+
+        timings = StreamTimings()
+        stats_lock = threading.Lock()
+
         def fetch_chunk(i: int) -> None:
-            """Stream one chunk: read each [leaf_idx, nbytes] frame, then
-            read the body straight into the leaf's final array."""
+            """Stream one chunk: read each range frame, then read the body
+            straight into the leaf's recv buffer at its offset."""
+            frame = _FRAME_V2 if version >= 2 else _FRAME
+            t0 = time.perf_counter()
+            chunk_bytes = 0
             with urllib.request.urlopen(
                 f"{base}/chunk_{i}", timeout=timeout_s
             ) as r:
                 while True:
-                    hdr = r.read(_FRAME.size)
+                    hdr = r.read(frame.size)
                     if not hdr:
-                        return
-                    leaf_idx, nbytes = _FRAME.unpack(hdr)
+                        break
+                    if len(hdr) < frame.size:
+                        raise ConnectionError(
+                            f"chunk {i}: truncated frame header"
+                        )
+                    if version >= 2:
+                        leaf_idx, off, nbytes = frame.unpack(hdr)
+                    else:
+                        leaf_idx, nbytes = frame.unpack(hdr)
+                        off = 0
+                    if not (0 <= leaf_idx < len(spec.leaves)):
+                        raise ConnectionError(
+                            f"chunk {i}: frame names leaf {leaf_idx} of "
+                            f"{len(spec.leaves)}"
+                        )
                     meta = spec.leaves[leaf_idx]
-                    if nbytes != meta.nbytes:
-                        # a short frame would exit the read loop cleanly
+                    if version < 2 and nbytes != meta.nbytes:
+                        # a short v1 frame would exit the read loop cleanly
                         # and leave the leaf — possibly a live template
                         # buffer — half-written with no error
                         raise ConnectionError(
@@ -333,42 +431,53 @@ class HTTPTransport(CheckpointTransport[Any]):
                             f"{nbytes} bytes but the leaf spec says "
                             f"{meta.nbytes}"
                         )
-                    if meta.kind == "array":
-                        target = _host_target(meta, leaf_idx)
-                        arr = target if target is not None else alloc_leaf(meta)
-                        mv = memoryview(arr.reshape(-1).view("u1"))
-                        got = 0
-                        while got < nbytes:
-                            n = r.readinto(mv[got:])
-                            if not n:
-                                raise ConnectionError(
-                                    f"chunk {i} truncated at leaf {leaf_idx}"
-                                )
-                            got += n
-                        if target is None and template_leaves is not None:
-                            # device template (device_put) or a mismatch
-                            # (warns "in-place receive degraded")
-                            arr = place_leaf_like(
-                                arr, template_leaves[leaf_idx], logger
-                            )
-                        payloads[leaf_idx] = arr
+                    if off < 0 or nbytes < 0 or off + nbytes > meta.nbytes:
+                        raise ConnectionError(
+                            f"chunk {i} leaf {leaf_idx}: range "
+                            f"[{off}, {off + nbytes}) outside the leaf's "
+                            f"{meta.nbytes} bytes"
+                        )
+                    buf = _buffer_for(leaf_idx)
+                    if isinstance(buf, bytearray):
+                        mv = memoryview(buf)[off : off + nbytes]
                     else:
-                        body = r.read(nbytes)
-                        if len(body) != nbytes:
-                            # read() returns short at EOF; without this the
-                            # loop exits cleanly and the truncation surfaces
-                            # later as an opaque UnpicklingError
+                        mv = memoryview(buf.reshape(-1).view("u1"))[
+                            off : off + nbytes
+                        ]
+                    got = 0
+                    while got < nbytes:
+                        n = r.readinto(mv[got:])
+                        if not n:
                             raise ConnectionError(
-                                f"chunk {i} truncated at pickled leaf "
-                                f"{leaf_idx} ({len(body)}/{nbytes} bytes)"
+                                f"chunk {i} truncated at leaf {leaf_idx} "
+                                f"({got}/{nbytes} bytes of range)"
                             )
-                        payloads[leaf_idx] = body
+                        got += n
+                    chunk_bytes += nbytes
+                    if _mark_written(leaf_idx, nbytes):
+                        _finish_leaf(leaf_idx)
+            with stats_lock:
+                timings.chunks.append(
+                    ChunkStat(
+                        nbytes=chunk_bytes,
+                        transfer_s=time.perf_counter() - t0,
+                    )
+                )
+                timings.total_bytes += chunk_bytes
 
+        t_all = time.perf_counter()
         with ThreadPoolExecutor(max_workers=max(1, min(num_chunks, 8))) as ex:
             list(ex.map(fetch_chunk, range(num_chunks)))
+        timings.total_s = time.perf_counter() - t_all
+        # zero-byte leaves get no range bytes on v2 wires; finalize them
+        for i, rem in enumerate(remaining):
+            if rem == 0 and payloads[i] is None:
+                _buffer_for(i)
+                _finish_leaf(i)
         missing = [i for i, p in enumerate(payloads) if p is None]
         if missing:
             raise RuntimeError(f"checkpoint chunks missing leaves {missing}")
+        self._last_recv_timings = timings
         return unflatten_state(spec, payloads)  # type: ignore[arg-type]
 
     def shutdown(self, wait: bool = True) -> None:

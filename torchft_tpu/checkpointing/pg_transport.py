@@ -28,7 +28,13 @@ from torchft_tpu.checkpointing._serialization import (
     place_leaf_like,
     template_leaves_for,
 )
-from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.checkpointing.transport import (
+    CheckpointTransport,
+    StreamTimings,
+    pipelined,
+    plan_wire_ranges,
+    stream_chunk_bytes,
+)
 from torchft_tpu.process_group import ProcessGroup
 
 logger = logging.getLogger(__name__)
@@ -142,20 +148,27 @@ class PGTransport(CheckpointTransport[Any]):
         spec, payloads = flatten_state(
             state_dict, snapshot=self._snapshot_send
         )
-        # Batched wire when the PG streams raw frames (direct
+        # Ranged wire when the PG streams raw frames (direct
         # ProcessGroupHost — recv_into is the capability marker): each
-        # message carries a GROUP of leaves (one pickled meta then raw
-        # back-to-back frames, mirroring the reference's one-pickled-meta +
-        # raw-tensor stream, pg_transport.py:202-305), so per-leaf control
-        # round-trips and Work futures amortize across the group while a
-        # Baby peer's per-message buffering stays capped at
-        # BATCH_GROUP_BYTES. The header tells the receiver which protocol
-        # is on the wire; the non-batched header stays a 2-tuple for
-        # pre-batching receivers.
-        batched = hasattr(self._pg, "recv_into")
-        header = pickle.dumps(
-            (step, spec, True) if batched else (step, spec)
-        )
+        # message carries a chunk of BYTE RANGES (leaf_idx, offset, nbytes)
+        # planned by plan_wire_ranges, so a single multi-GB leaf splits
+        # across messages and the receiver overlaps the recv of chunk i+1
+        # with the device placement of chunk i (pipelined heal). The plan
+        # rides the header — no cross-host determinism requirement on the
+        # chunk-size knob. The header tells the receiver which protocol is
+        # on the wire; the non-ranged header stays a 2-tuple for pre-split
+        # receivers, and the legacy batched protocol is still understood
+        # on receive for mixed-version heals.
+        ranged = hasattr(self._pg, "recv_into")
+        ranges: Optional[List[Any]] = None
+        if ranged:
+            chunk_bytes = min(self.BATCH_GROUP_BYTES, stream_chunk_bytes())
+            ranges = plan_wire_ranges(
+                [m.nbytes for m in spec.leaves], chunk_bytes
+            )
+            header = pickle.dumps((step, spec, "ranged", ranges))
+        else:
+            header = pickle.dumps((step, spec))
         wires = [
             buf.reshape(-1).view(np.uint8)
             if isinstance(buf, np.ndarray)
@@ -166,10 +179,18 @@ class PGTransport(CheckpointTransport[Any]):
             self._pg.send([np.frombuffer(header, dtype=np.uint8)], dst, tag=1).wait(
                 self._timeout
             )
-            if batched:
-                for group in self._wire_groups(spec):
-                    self._pg.send([wires[i] for i in group], dst, tag=2) \
-                        .wait(self._timeout)
+            if ranged:
+                assert ranges is not None
+                # windowed like the per-leaf path: bounds in-flight chunk
+                # copies on a buffering peer while keeping the wire busy
+                pending: List[Any] = []
+                for chunk in ranges:
+                    bufs = [wires[j][off : off + ln] for (j, off, ln) in chunk]
+                    pending.append(self._pg.send(bufs, dst, tag=2))
+                    if len(pending) >= self.SEND_WINDOW:
+                        pending.pop(0).wait(self._timeout)
+                for work in pending:
+                    work.wait(self._timeout)
                 continue
             # Windowed per-leaf sends: keep at most SEND_WINDOW leaves in
             # flight. The window is not about caller overlap — it is
@@ -193,10 +214,11 @@ class PGTransport(CheckpointTransport[Any]):
             timeout.total_seconds() if isinstance(timeout, timedelta) else timeout
         )
         header = self._pg.recv(src_rank, tag=1).get_future().wait(timeout_s)
-        # tolerant unpack: a pre-batching peer sends (step, spec) — treat
-        # as the per-leaf wire so mixed-version heals still work
+        # tolerant unpack: a pre-batching peer sends (step, spec), a
+        # batching peer (step, spec, True), a ranged peer
+        # (step, spec, "ranged", ranges) — mixed-version heals still work
         got_step, spec, *rest = pickle.loads(bytes(header[0]))
-        batched = rest[0] if rest else False
+        proto = rest[0] if rest else False
         if got_step != step:
             raise RuntimeError(f"expected checkpoint step {step}, got {got_step}")
 
@@ -236,7 +258,11 @@ class PGTransport(CheckpointTransport[Any]):
             return leaf
 
         payload_leaves: List[Any] = []
-        if batched:
+        if proto == "ranged":
+            return self._recv_ranged(
+                src_rank, spec, rest[1], template_leaves, timeout_s
+            )
+        if proto:
             # one message per wire group (same deterministic grouping as
             # the sender derives from this spec). Absorb-capable template
             # leaves ride as preallocated views so their raw frames stream
@@ -304,6 +330,124 @@ class PGTransport(CheckpointTransport[Any]):
 
         treedef = pickle.loads(spec.treedef_bytes)
         return jax.tree_util.tree_unflatten(treedef, payload_leaves)
+
+    def _recv_ranged(
+        self,
+        src_rank: int,
+        spec: TreeSpecPayload,
+        ranges: List[List[Any]],
+        template_leaves: Optional[List[Any]],
+        timeout_s: float,
+    ) -> Any:
+        """Receive the ranged wire: one message per chunk of byte ranges
+        (the plan rode the header). The recv of chunk i+1 runs on a worker
+        thread while this thread finalizes (device-places) the leaves
+        chunk i completed — the pipelining that hides placement behind the
+        wire for multi-chunk heals."""
+        recv_into = getattr(self._pg, "recv_into", None)
+
+        # flat uint8 destination per leaf: absorb-capable template leaves
+        # expose their own memory (frames stream straight in), the rest
+        # get a wire buffer reused across that leaf's ranges
+        dests: List[np.ndarray] = []
+        absorbed: List[bool] = []
+        for i, meta in enumerate(spec.leaves):
+            target = None
+            if (
+                recv_into is not None
+                and template_leaves is not None
+                and meta.kind == "array"
+                and can_absorb(
+                    template_leaves[i],
+                    meta.shape,
+                    meta.dtype,
+                    require_contiguous=True,
+                )
+            ):
+                target = template_leaves[i]
+            if target is not None:
+                dests.append(target.reshape(-1).view(np.uint8))
+                absorbed.append(True)
+            else:
+                dests.append(np.empty(meta.nbytes, np.uint8))
+                absorbed.append(False)
+
+        payloads: List[Optional[Any]] = [None] * len(spec.leaves)
+        remaining: List[int] = [m.nbytes for m in spec.leaves]
+
+        def _finalize(i: int) -> None:
+            meta = spec.leaves[i]
+            if absorbed[i]:
+                assert template_leaves is not None
+                payloads[i] = template_leaves[i]
+                return
+            leaf = leaf_from_bytes(meta, dests[i])
+            if template_leaves is not None and meta.kind == "array":
+                leaf = place_leaf_like(leaf, template_leaves[i], logger)
+            payloads[i] = leaf
+
+        def transfer(chunk: List[Any]) -> List[Any]:
+            gviews = [dests[j][off : off + ln] for (j, off, ln) in chunk]
+            if recv_into is not None:
+                got = self._pg.recv_into(gviews, src_rank, tag=2) \
+                    .get_future().wait(timeout_s)
+            else:
+                got = self._pg.recv(src_rank, tag=2).get_future().wait(
+                    timeout_s
+                )
+            n_got = len(got) if got else 0
+            if n_got != len(chunk):
+                err = self._pg.errored()
+                raise RuntimeError(
+                    f"ranged recv from rank {src_rank} returned {n_got} of "
+                    f"{len(chunk)} ranges (pg errored: {err})"
+                )
+            for k, (j, _off, ln) in enumerate(chunk):
+                if got[k] is gviews[k]:
+                    continue  # absorbed straight into the destination
+                src = got[k]
+                buf = (
+                    src.reshape(-1).view(np.uint8)
+                    if isinstance(src, np.ndarray)
+                    else np.frombuffer(src, np.uint8)
+                )
+                if buf.size != ln:
+                    raise RuntimeError(
+                        f"ranged recv: range {k} of chunk carries "
+                        f"{buf.size} bytes, plan says {ln}"
+                    )
+                np.copyto(gviews[k], buf)
+            return chunk
+
+        def finish(chunk: List[Any]) -> None:
+            for j, _off, ln in chunk:
+                remaining[j] -= ln
+                if remaining[j] < 0:
+                    raise RuntimeError(
+                        f"leaf {j}: overlapping/duplicate wire ranges"
+                    )
+                if remaining[j] == 0 and payloads[j] is None:
+                    _finalize(j)
+
+        timings = StreamTimings()
+        pipelined(
+            ranges,
+            transfer,
+            finish,
+            depth=2,
+            timings=timings,
+            size_of=lambda c: sum(ln for (_j, _o, ln) in c),
+        )
+        self._last_recv_timings = timings
+
+        missing = [i for i, p in enumerate(payloads) if p is None]
+        if missing:
+            raise RuntimeError(f"ranged checkpoint missing leaves {missing}")
+
+        import jax
+
+        treedef = pickle.loads(spec.treedef_bytes)
+        return jax.tree_util.tree_unflatten(treedef, payloads)
 
     def shutdown(self, wait: bool = True) -> None:
         pass  # the PG is owned by the caller

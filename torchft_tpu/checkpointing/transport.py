@@ -1,19 +1,197 @@
-"""Checkpoint transport interface.
+"""Checkpoint transport interface + shared streaming helpers.
 
 Mirror of the reference CheckpointTransport ABC
 (torchft/checkpointing/transport.py:14-68): live-recovery state streaming
 between replica groups. ``state_dict`` here is any JAX pytree.
+
+The streaming helpers are the shared half of the pipelined heal path used
+by both concrete transports:
+
+- ``plan_wire_ranges`` chunks a flattened state into byte ranges
+  ``(leaf_idx, offset, nbytes)`` — BYTE-granular, so a single multi-GB
+  leaf (the common shape for a fused parameter buffer) still splits into
+  multiple wire chunks instead of store-and-forwarding as one blob;
+- ``pipelined`` overlaps the wire transfer of chunk ``i+1`` with the
+  finish work (device placement / reassembly) of chunk ``i``;
+- ``StreamTimings`` / ``ChunkStat`` carry per-chunk throughput back to
+  the Manager (``heal_chunks`` / ``heal_mb_per_s`` timings).
 """
 
 from __future__ import annotations
 
+import os
+import queue
+import threading
+import time
 from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import Any, Generic, List, TypeVar
+from typing import Any, Callable, Generic, Iterable, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T")
+U = TypeVar("U")
 
-__all__ = ["CheckpointTransport"]
+__all__ = [
+    "CheckpointTransport",
+    "ChunkStat",
+    "StreamTimings",
+    "pipelined",
+    "plan_wire_ranges",
+    "stream_chunk_bytes",
+]
+
+STREAM_CHUNK_BYTES_ENV = "TORCHFT_STREAM_CHUNK_BYTES"
+DEFAULT_STREAM_CHUNK_BYTES = 32 << 20  # 32 MiB
+
+
+def stream_chunk_bytes() -> int:
+    """Target wire-chunk size for streamed heal transfers, overridable via
+    ``TORCHFT_STREAM_CHUNK_BYTES`` (values < 1 fall back to the default —
+    a zero chunk size would loop forever in ``plan_wire_ranges``)."""
+    raw = os.environ.get(STREAM_CHUNK_BYTES_ENV, "")
+    try:
+        val = int(raw)
+    except ValueError:
+        return DEFAULT_STREAM_CHUNK_BYTES
+    return val if val >= 1 else DEFAULT_STREAM_CHUNK_BYTES
+
+
+def plan_wire_ranges(
+    leaf_nbytes: List[int], chunk_bytes: int
+) -> List[List[Tuple[int, int, int]]]:
+    """Plan wire chunks over flattened leaves as byte ranges.
+
+    Returns a list of chunks, each a list of ``(leaf_idx, offset, nbytes)``
+    ranges summing to at most ``chunk_bytes`` (except that every range is
+    non-empty, so a chunk always makes progress). Unlike leaf-granularity
+    ``split_chunks``, a leaf larger than ``chunk_bytes`` is split across
+    chunks — that is what lets a single huge parameter buffer pipeline.
+    Deterministic in its inputs, so sender and receiver can independently
+    derive the same plan. Zero-byte leaves ride along with the next chunk
+    (offset 0, nbytes 0) so every leaf appears in exactly one range."""
+    if chunk_bytes < 1:
+        raise ValueError(f"chunk_bytes must be >= 1, got {chunk_bytes}")
+    chunks: List[List[Tuple[int, int, int]]] = []
+    cur: List[Tuple[int, int, int]] = []
+    cur_bytes = 0
+    for idx, total in enumerate(leaf_nbytes):
+        if total == 0:
+            cur.append((idx, 0, 0))
+            continue
+        off = 0
+        while off < total:
+            take = min(total - off, chunk_bytes - cur_bytes)
+            if take == 0:
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+                continue
+            cur.append((idx, off, take))
+            off += take
+            cur_bytes += take
+            if cur_bytes >= chunk_bytes:
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+    if cur:
+        chunks.append(cur)
+    if not chunks:
+        chunks.append([])
+    return chunks
+
+
+@dataclass
+class ChunkStat:
+    """Wire timing of one streamed chunk (transfer only, not finish)."""
+
+    nbytes: int
+    transfer_s: float
+
+
+@dataclass
+class StreamTimings:
+    """Aggregate stats of the last streamed recv, surfaced to the Manager
+    via ``CheckpointTransport.last_recv_timings``."""
+
+    total_bytes: int = 0
+    total_s: float = 0.0
+    chunks: List[ChunkStat] = field(default_factory=list)
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunks)
+
+    @property
+    def mb_per_s(self) -> float:
+        if self.total_s <= 0:
+            return 0.0
+        return (self.total_bytes / (1 << 20)) / self.total_s
+
+
+def pipelined(
+    items: Iterable[T],
+    transfer: Callable[[T], U],
+    finish: Callable[[U], None],
+    depth: int = 2,
+    timings: Optional[StreamTimings] = None,
+    size_of: Optional[Callable[[U], int]] = None,
+) -> None:
+    """Run ``transfer`` over ``items`` on a worker thread while ``finish``
+    consumes completed results on the calling thread — chunk ``i+1`` is on
+    the wire while chunk ``i`` is being placed. ``depth`` bounds how many
+    transferred-but-unfinished results may buffer (memory bound). A failure
+    on either side aborts the stream: the worker stops at the next queue
+    put, and the first exception (transfer wins over finish) propagates."""
+    q: "queue.Queue[Tuple[bool, Any]]" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+    t_start = time.perf_counter()
+
+    def producer() -> None:
+        try:
+            for item in items:
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                out = transfer(item)
+                dt = time.perf_counter() - t0
+                if timings is not None:
+                    nb = size_of(out) if size_of is not None else 0
+                    timings.chunks.append(ChunkStat(nbytes=nb, transfer_s=dt))
+                    timings.total_bytes += nb
+                q.put((True, out))
+            q.put((True, _DONE))
+        except BaseException as e:  # noqa: BLE001 — must unblock the consumer
+            q.put((False, e))
+
+    worker = threading.Thread(
+        target=producer, name="torchft_stream", daemon=True
+    )
+    worker.start()
+    try:
+        while True:
+            ok, payload = q.get()
+            if not ok:
+                raise payload
+            if payload is _DONE:
+                break
+            finish(payload)
+    except BaseException:
+        stop.set()
+        # drain one slot so a blocked producer put() can observe stop
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        raise
+    finally:
+        worker.join(timeout=60)
+        if timings is not None:
+            timings.total_s = time.perf_counter() - t_start
+
+
+class _Done:
+    __slots__ = ()
+
+
+_DONE = _Done()
 
 
 class CheckpointTransport(ABC, Generic[T]):
@@ -56,6 +234,13 @@ class CheckpointTransport(ABC, Generic[T]):
         self, src_rank: int, metadata: str, step: int, timeout: "float | timedelta"
     ) -> T:
         """Fetch the state for ``step`` from ``src_rank``."""
+
+    def last_recv_timings(self) -> Optional[StreamTimings]:
+        """Chunk-stream stats of the most recent ``recv_checkpoint`` (None
+        when the transport doesn't stream or hasn't received yet). The
+        Manager folds these into its ``timings()`` as ``heal_chunks`` /
+        ``heal_mb_per_s``."""
+        return getattr(self, "_last_recv_timings", None)
 
     def shutdown(self, wait: bool = True) -> None:
         """Tear down (terminal)."""

@@ -26,6 +26,7 @@ import logging
 import os
 import socket as _socket
 import threading
+import time
 import traceback
 import uuid
 from concurrent.futures import ThreadPoolExecutor
@@ -47,6 +48,7 @@ from torchft_tpu.observability import (
     log_commit_event,
     log_error_event,
     log_quorum_event,
+    log_timing_event,
     trace_span,
     traced,
 )
@@ -158,12 +160,15 @@ class Manager:
         self._min_replica_size = min_replica_size
         self._use_async_quorum = use_async_quorum
         if use_async_quorum and getattr(pg, "requires_sync_quorum", False):
-            # a PG that rebuilds the jax backend on reconfigure (per-quorum
-            # distributed worlds) cannot run configure concurrently with
-            # the trainer's own jax computations: the main thread and the
-            # quorum thread would race backend init mid-rebuild. Quorum
-            # latency moves onto the critical path, which is the price of
-            # an in-process world swap.
+            # Safety valve for PGs WITHOUT a prepare/commit configure
+            # split that still rebuild global device state inside
+            # configure: running that concurrently with the trainer's own
+            # jax computations would race backend init mid-rebuild.
+            # ProcessGroupXLA no longer sets this — its prepare_configure
+            # stages the control plane on the quorum thread and hands the
+            # backend swap back as a commit this Manager applies from the
+            # main thread (_commit_pending_configure), so async quorum is
+            # safe on the device plane.
             logger.info(
                 "pg %s requires sync quorum; overriding use_async_quorum",
                 type(pg).__name__,
@@ -279,6 +284,17 @@ class Manager:
         self._healing = False
         self._last_quorum_healed = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
+        # prepare/commit configure split: the quorum thread stages the
+        # reconfigure (prepare_configure) and stashes the returned commit
+        # here; the main thread applies it at the next safe point via
+        # _commit_pending_configure. Guarded by its own lock so a late
+        # quorum-thread stash can't race the main-thread take.
+        self._pending_pg_commit: Optional[Callable[[], None]] = None
+        self._pending_commit_lock = threading.Lock()
+        # per-phase wall-clock timings for the most recent quorum cycle
+        # (quorum_overlap_s, configure_prepare_s, configure_commit_s,
+        # heal_recv_s, ...) — shares _metrics_lock
+        self._timings: Dict[str, float] = {}
         self._participating_replica_rank: Optional[int] = None
         # last seen PG backend generation (see _sync_device_world)
         self._device_world_epoch = getattr(pg, "device_world_epoch", None)
@@ -351,6 +367,10 @@ class Manager:
         new step. Call before the forward pass (reference: manager.py:560-615)."""
         if self._quorum_future is not None:
             self._quorum_future.result()
+            # a commit left over from the previous quorum (e.g. the caller
+            # skipped should_commit after an error) must land before the
+            # next prepare runs against the old world
+            self._commit_pending_configure()
 
         self._errored = None
         self._healing = False
@@ -364,6 +384,7 @@ class Manager:
         )
         if not self._use_async_quorum:
             self.wait_quorum()
+            self._commit_pending_configure()
             self._sync_device_world()
             if self._healing and self._pending_state_dict is not None:
                 # apply eagerly so the forward pass runs on recovered state
@@ -412,6 +433,19 @@ class Manager:
 
     @traced("torchft::manager::_async_quorum")
     def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
+    ) -> None:
+        # quorum_overlap_s is the wall-clock the whole control-plane cycle
+        # spent on the quorum thread — with async quorum this is the work
+        # the train step no longer waits for (minus configure_commit_s,
+        # the only piece that still serializes with the trainer)
+        t0 = time.perf_counter()
+        try:
+            self._async_quorum_body(allow_heal, shrink_only, quorum_timeout)
+        finally:
+            self._record_timing("quorum_overlap_s", time.perf_counter() - t0)
+
+    def _async_quorum_body(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
         try:
@@ -473,13 +507,28 @@ class Manager:
             )
             try:
                 self._bump_metric("reconfigures")
-                with trace_span("torchft::manager::_pg::configure"):
-                    self._pg.configure(
+                # prepare/commit split: everything control-plane runs HERE
+                # on the quorum thread; a PG that must swap live backend
+                # state returns that swap as a commit callable which the
+                # main thread applies at the next safe point
+                t_prep = time.perf_counter()
+                with trace_span("torchft::manager::_pg::prepare_configure"):
+                    pg_commit = self._pg.prepare_configure(
                         store_prefixed_addr,
                         quorum.replica_rank,
                         quorum.replica_world_size,
                         quorum_id=quorum.quorum_id,
                     )
+                self._record_timing(
+                    "configure_prepare_s", time.perf_counter() - t_prep
+                )
+                with self._pending_commit_lock:
+                    self._pending_pg_commit = pg_commit
+                if pg_commit is None:
+                    # fully committed in prepare (host PGs, local mode):
+                    # report an explicit zero so BENCH rows always carry
+                    # the key and overlap math stays artifact-derivable
+                    self._record_timing("configure_commit_s", 0.0)
                 # keep the checkpoint transport in lockstep with the quorum
                 # (no-op for address-based transports; PGTransport
                 # rendezvouses its recovery PG here). Distinct /recovery
@@ -512,6 +561,10 @@ class Manager:
                     replica=self._replica_id,
                     group_rank=self._group_rank,
                 )
+                if pg_commit is None:
+                    # split PGs log theirs from _commit_pending_configure,
+                    # after the commit half has a measured duration
+                    self._log_timing_snapshot("configure_prepare")
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in pg configure: {e}")
                 self.report_error(e)
@@ -523,6 +576,7 @@ class Manager:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
+                    t_send = time.perf_counter()
                     with trace_span("torchft::manager::send_checkpoint"):
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=quorum.recover_dst_replica_ranks,
@@ -530,6 +584,9 @@ class Manager:
                             state_dict=self._manager_state_dict(),
                             timeout=self._timeout,
                         )
+                    self._record_timing(
+                        "heal_send_s", time.perf_counter() - t_send
+                    )
 
                 if quorum.heal:
                     self._healing = True
@@ -544,6 +601,7 @@ class Manager:
                         self._group_rank, timeout=self._timeout
                     )
                     assert quorum.recover_src_replica_rank is not None
+                    t_recv = time.perf_counter()
                     with trace_span("torchft::manager::recv_checkpoint"):
                         self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
                             src_rank=quorum.recover_src_replica_rank,
@@ -551,6 +609,13 @@ class Manager:
                             step=quorum.max_step,
                             timeout=self._timeout,
                         )
+                    self._record_timing(
+                        "heal_recv_s", time.perf_counter() - t_recv
+                    )
+                    stream = self._checkpoint_transport.last_recv_timings()
+                    if stream is not None:
+                        self._record_timing("heal_chunks", float(stream.num_chunks))
+                        self._record_timing("heal_mb_per_s", stream.mb_per_s)
                     # restore ft step/batches immediately; user state is
                     # applied from the main thread when safe
                     self.load_state_dict(self._pending_state_dict["torchft"])
@@ -573,6 +638,32 @@ class Manager:
             self._pending_state_dict = None
         self._last_quorum_healed = True
         self._bump_metric("heals")
+
+    def _commit_pending_configure(self) -> None:
+        """Apply the backend-swap half of a split reconfigure. MUST run on
+        the main thread (the commit swaps live jax backend state that the
+        trainer's own computations touch); called at every sync point —
+        start_quorum, allreduce-after-wait, should_commit. No-op when the
+        last prepare had nothing to commit."""
+        with self._pending_commit_lock:
+            commit, self._pending_pg_commit = self._pending_pg_commit, None
+        if commit is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            with trace_span("torchft::manager::configure_commit"):
+                commit()
+        except Exception as e:  # noqa: BLE001
+            # force the next quorum cycle to re-run prepare+commit even if
+            # the lighthouse hands back the same quorum_id: _quorum_id was
+            # already recorded after prepare succeeded, so without this the
+            # reconfigure would be skipped and the PG left half-configured
+            self._quorum_id = -1
+            self._logger.exception(f"got exception in pg configure commit: {e}")
+            self.report_error(e)
+        finally:
+            self._record_timing("configure_commit_s", time.perf_counter() - t0)
+            self._log_timing_snapshot("configure_commit")
 
     # ------------------------------------------------------------ allreduce
     @traced("torchft::manager::allreduce")
@@ -645,6 +736,12 @@ class Manager:
             return DummyWork(zeros())
 
         self.wait_quorum()
+        # a reconfigure that landed during the forward pass commits its
+        # backend swap here, before the collective touches the PG — this
+        # is the "next safe point" for steps that skip should_commit
+        self._commit_pending_configure()
+        if self.errored():
+            return DummyWork(zeros())
         num_participants = self.num_participants()
 
         # Device-native PGs (ProcessGroupXLA) take jax.Arrays straight
@@ -874,6 +971,35 @@ class Manager:
         with self._metrics_lock:
             return dict(self._metrics)
 
+    def _record_timing(self, name: str, value: float) -> None:
+        with self._metrics_lock:
+            self._timings[name] = value
+
+    def timings(self) -> Dict[str, float]:
+        """Per-phase wall-clock of the most recent quorum cycle:
+        ``quorum_overlap_s`` (control-plane time on the quorum thread —
+        hidden from the train step under async quorum),
+        ``configure_prepare_s`` / ``configure_commit_s`` (the split
+        reconfigure; commit is the only part that serializes with the
+        trainer), and ``heal_send_s`` / ``heal_recv_s`` plus
+        ``heal_chunks`` / ``heal_mb_per_s`` when the checkpoint transport
+        reports chunk-stream stats. Keys appear once the phase has run."""
+        with self._metrics_lock:
+            return dict(self._timings)
+
+    def _log_timing_snapshot(self, phase: str) -> None:
+        try:
+            log_timing_event(
+                replica_id=self._replica_id,
+                group_rank=self._group_rank,
+                step=self._step,
+                quorum_id=self._quorum_id,
+                phase=phase,
+                **self.timings(),
+            )
+        except Exception:  # noqa: BLE001
+            self._logger.exception("failed to log timing snapshot")
+
     # ------------------------------------------------------------- errors
     def report_error(self, e: Exception) -> None:
         """Mark the step as corrupt; it will be discarded at should_commit
@@ -961,6 +1087,12 @@ class Manager:
                 self._quorum_future.result()
             except Exception as e:  # noqa: BLE001
                 self.report_error(e)
+
+        # apply a pending backend swap BEFORE sampling pg.errored(): after
+        # a membership change the OLD world is typically errored (the abort
+        # that triggered the change); the sync flow cleared that state
+        # inside configure, the split flow clears it at commit
+        self._commit_pending_configure()
 
         if (err := self._pg.errored()) is not None:
             self.report_error(err)
@@ -1138,6 +1270,9 @@ class Manager:
         if self._store is not None:
             self._store.shutdown()
         self._executor.shutdown(wait=wait)
+        # never apply a backend swap during teardown — drop it
+        with self._pending_commit_lock:
+            self._pending_pg_commit = None
         # cancel queued (not-yet-run) staging tasks on a non-waiting
         # shutdown: they would otherwise dispatch against the PG after
         # pg.shutdown below, spuriously reporting errors on a torn-down

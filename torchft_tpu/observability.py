@@ -6,7 +6,9 @@ Three structured event streams mirror the reference's loggers:
 - ``torchft_quorums`` — one record per quorum change (quorum id, replicas,
   participation, heal/recovery roles);
 - ``torchft_commits`` — one record per ``should_commit`` decision;
-- ``torchft_errors`` — one record per reported error / PG abort.
+- ``torchft_errors`` — one record per reported error / PG abort;
+- ``torchft_timings`` — per-phase wall-clock snapshots of a reconfigure
+  cycle (quorum overlap, configure prepare/commit, heal transfer).
 
 Records are JSON-serialised into the standard ``logging`` stream, and — when
 ``TORCHFT_USE_OTEL=1`` and the ``opentelemetry`` packages are importable —
@@ -34,6 +36,9 @@ OTEL_RESOURCE_ATTRS_ENV = "TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON"
 QUORUM_EVENTS = "torchft_quorums"
 COMMIT_EVENTS = "torchft_commits"
 ERROR_EVENTS = "torchft_errors"
+# per-phase wall-clock snapshots of a quorum/reconfigure cycle
+# (quorum_overlap_s, configure_prepare_s, configure_commit_s, heal_*)
+TIMING_EVENTS = "torchft_timings"
 
 _otel_providers: Dict[str, Any] = {}
 
@@ -131,6 +136,10 @@ def log_commit_event(**fields: Any) -> None:
 
 def log_error_event(**fields: Any) -> None:
     get_event_logger(ERROR_EVENTS).log(**fields)
+
+
+def log_timing_event(**fields: Any) -> None:
+    get_event_logger(TIMING_EVENTS).log(**fields)
 
 
 def traced(name: str):

@@ -147,6 +147,34 @@ class ProcessGroup(ABC):
         collide (reference: manager.py:703-705).
         """
 
+    def prepare_configure(
+        self,
+        store_addr: str,
+        replica_rank: int,
+        replica_world_size: int,
+        quorum_id: int = 0,
+    ) -> Optional[Callable[[], None]]:
+        """Two-phase configure: run everything that is safe off the main
+        thread NOW and return the main-thread commit, or None when nothing
+        needs the main thread.
+
+        The Manager calls this from its quorum executor thread so the
+        control-plane round-trip (rendezvous, membership barriers) overlaps
+        the trainer's compute; whatever the returned callable does (e.g. a
+        live jax-backend swap in ProcessGroupXLA's distributed mode) is
+        applied by the Manager from the main thread at the next safe point.
+
+        Default: the whole configure is prepare — host-plane PGs touch no
+        global device runtime, so running configure on the quorum thread is
+        already safe. Routed through ``self.configure`` (not a base
+        implementation) so instance-attribute shadowing of ``configure``
+        (timing wrappers, test mocks) keeps seeing every reconfigure.
+        """
+        self.configure(
+            store_addr, replica_rank, replica_world_size, quorum_id=quorum_id
+        )
+        return None
+
     @abstractmethod
     def abort(self) -> None:
         """Hard-kill in-flight collectives; the PG stays errored until
@@ -1444,6 +1472,25 @@ class ErrorSwallowingProcessGroupWrapper(ProcessGroup):
         self._error = None
         self._pg.configure(store_addr, replica_rank, replica_world_size, quorum_id)
 
+    def prepare_configure(
+        self, store_addr, replica_rank, replica_world_size, quorum_id=0
+    ) -> Optional[Callable[[], None]]:
+        # forward the split so wrapping a prepare/commit PG keeps the commit
+        # on the main thread; the swallowed-error state clears when the new
+        # communicator is actually LIVE (commit time for split PGs)
+        inner = self._pg.prepare_configure(
+            store_addr, replica_rank, replica_world_size, quorum_id=quorum_id
+        )
+        if inner is None:
+            self._error = None
+            return None
+
+        def commit() -> None:
+            inner()
+            self._error = None
+
+        return commit
+
     def abort(self) -> None:
         self._pg.abort()
 
@@ -1525,6 +1572,10 @@ class FakeProcessGroupWrapper(ProcessGroup):
         self._pg = pg
         self._next_error: Optional[Exception] = None
         self._next_configure_error: Optional[Exception] = None
+        # test hook: called at the START of prepare_configure (on the
+        # quorum thread) — EventInjector uses it to stall the prepare
+        # phase past a step boundary deterministically
+        self._on_prepare: Optional[Callable[[], None]] = None
 
     @property
     def device_native(self) -> bool:
@@ -1536,11 +1587,29 @@ class FakeProcessGroupWrapper(ProcessGroup):
     def report_configure_error(self, e: Exception) -> None:
         self._next_configure_error = e
 
+    def set_prepare_hook(self, fn: Optional[Callable[[], None]]) -> None:
+        self._on_prepare = fn
+
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
         if self._next_configure_error is not None:
             e, self._next_configure_error = self._next_configure_error, None
             raise e
         self._pg.configure(store_addr, replica_rank, replica_world_size, quorum_id)
+
+    def prepare_configure(
+        self, store_addr, replica_rank, replica_world_size, quorum_id=0
+    ) -> Optional[Callable[[], None]]:
+        # injection parity with configure(): a staged configure error fires
+        # during PREPARE (that is where the real failures live — rendezvous,
+        # membership barriers), and the prepare hook runs before it
+        if self._on_prepare is not None:
+            self._on_prepare()
+        if self._next_configure_error is not None:
+            e, self._next_configure_error = self._next_configure_error, None
+            raise e
+        return self._pg.prepare_configure(
+            store_addr, replica_rank, replica_world_size, quorum_id=quorum_id
+        )
 
     def abort(self) -> None:
         self._pg.abort()

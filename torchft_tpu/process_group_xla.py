@@ -440,11 +440,15 @@ class ProcessGroupXLA(ProcessGroup):
 
     @property
     def requires_sync_quorum(self) -> bool:
-        """True when configure may rebuild the jax backend (distributed
-        mode, or auto before it resolves): the Manager must then run
-        quorum+configure synchronously so the trainer's jax computations
-        never race a backend teardown on the quorum thread."""
-        return self._mode != "local"
+        """Always False since the prepare/commit configure split: the
+        control-plane part of a reconfigure (quorum-scoped coordinator
+        rendezvous through the KV store) runs on the quorum thread via
+        ``prepare_configure``, and the only backend-touching piece — the
+        jax world swap in distributed mode — is returned as a commit
+        callable the Manager applies from the main thread at the next
+        safe point. The Manager still honors True from third-party PGs
+        without the split (the safety valve this property used to be)."""
+        return False
 
     @property
     def device_world_epoch(self) -> int:
@@ -511,8 +515,25 @@ class ProcessGroupXLA(ProcessGroup):
 
     # ------------------------------------------------------------ lifecycle
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
-        import jax
+        commit = self.prepare_configure(
+            store_addr, replica_rank, replica_world_size, quorum_id=quorum_id
+        )
+        if commit is not None:
+            commit()
 
+    def prepare_configure(
+        self, store_addr, replica_rank, replica_world_size, quorum_id=0
+    ) -> Optional[Callable[[], None]]:
+        """Two-phase configure (see ProcessGroup.prepare_configure).
+
+        Local mode never touches the process-global jax runtime, so the
+        whole configure is prepare-safe and there is nothing to commit.
+        Distributed mode stages the control plane here — the quorum-scoped
+        coordinator rendezvous through the KV store, including the blocking
+        wait for rank 0's address — and returns the backend swap (world
+        teardown + ``jax.distributed`` rejoin + mesh build) as the commit,
+        because ONLY the swap can race the trainer's own jax computations.
+        """
         mode = self._mode
         if mode == "auto":
             # "auto" resolves to local: picking distributed here would
@@ -524,6 +545,24 @@ class ProcessGroupXLA(ProcessGroup):
             # pointer here when local mode can't cover the world).
             mode = "local"
 
+        if mode == "local":
+            self._retire_current_world()
+            world = self._configure_local(store_addr, replica_world_size, quorum_id)
+            self._install_world(world, replica_rank, replica_world_size)
+            return None
+
+        coord = self._stage_distributed(store_addr, replica_rank, quorum_id)
+
+        def commit() -> None:
+            self._retire_current_world()
+            world = self._configure_distributed(
+                coord, replica_rank, replica_world_size, quorum_id
+            )
+            self._install_world(world, replica_rank, replica_world_size)
+
+        return commit
+
+    def _retire_current_world(self) -> None:
         with self._lock:
             old, self._world = self._world, None
             self._seq = {}  # fresh op ordering per generation
@@ -545,13 +584,7 @@ class ProcessGroupXLA(ProcessGroup):
                 for mb in stale_mbs:
                     mb.fail(old.error)
 
-        if mode == "local":
-            world = self._configure_local(store_addr, replica_world_size, quorum_id)
-        else:
-            world = self._configure_distributed(
-                store_addr, replica_rank, replica_world_size, quorum_id
-            )
-
+    def _install_world(self, world: _XlaWorld, replica_rank, replica_world_size) -> None:
         with self._lock:
             self._world = world
             self._rank = replica_rank
@@ -586,16 +619,11 @@ class ProcessGroupXLA(ProcessGroup):
                 _local_worlds[key] = world
         return world
 
-    def _configure_distributed(
-        self, store_addr, rank, world_size, quorum_id
-    ) -> _XlaWorld:
-        """Join the per-quorum ``jax.distributed`` world.
-
-        Rank 0 publishes a coordinator address under the quorum-scoped KV
-        prefix; everyone initializes against it."""
-        import jax
-        from jax.sharding import Mesh
-
+    def _stage_distributed(self, store_addr, rank, quorum_id) -> str:
+        """Control-plane half of a distributed reconfigure — safe on the
+        quorum thread. Rank 0 publishes a coordinator address under the
+        quorum-scoped KV prefix; everyone else blocks on the get until it
+        lands. Pure KV RPCs: no jax state is touched."""
         host_port, _, path = store_addr.partition("/")
         prefix = f"{path or 'pgxla'}/{quorum_id}"
         kv = KvClient(host_port, connect_timeout=self._timeout)
@@ -605,6 +633,16 @@ class ProcessGroupXLA(ProcessGroup):
             kv.set(f"{prefix}/xla_coordinator", coord, timeout=self._timeout)
         else:
             coord = kv.get(f"{prefix}/xla_coordinator", timeout=self._timeout).decode()
+        return coord
+
+    def _configure_distributed(
+        self, coord, rank, world_size, quorum_id
+    ) -> _XlaWorld:
+        """Backend half of a distributed reconfigure: join the per-quorum
+        ``jax.distributed`` world at the pre-rendezvoused coordinator and
+        build the mesh. Runs at COMMIT time, on the Manager's main thread."""
+        import jax
+        from jax.sharding import Mesh
 
         _join_distributed_world(coord, rank, world_size, self._timeout)
 

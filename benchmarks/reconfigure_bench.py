@@ -178,6 +178,8 @@ def measure_spares_noop(steps: int = 6) -> dict:
         quorum_tick_ms=20, heartbeat_timeout_ms=2000,
     )
     lat: dict = {}
+    vote_rpc: dict = {}
+    bookkeeping: dict = {}
 
     def replica(rid: int) -> None:
         manager = Manager(
@@ -193,13 +195,22 @@ def measure_spares_noop(steps: int = 6) -> dict:
             world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
         )
         times = []
+        rpcs = []
+        books = []
         try:
             for _ in range(steps):
                 t0 = time.perf_counter()
                 manager.start_quorum()
                 times.append((time.perf_counter() - t0) * 1e3)
                 manager.should_commit()
+                t = manager.timings()
+                if t.get("should_commit_rpc_s") is not None:
+                    rpcs.append(t["should_commit_rpc_s"] * 1e3)
+                if t.get("bookkeeping_s") is not None:
+                    books.append(t["bookkeeping_s"] * 1e3)
             lat[rid] = times
+            vote_rpc[rid] = rpcs
+            bookkeeping[rid] = books
         finally:
             manager.shutdown(wait=False)
 
@@ -212,7 +223,19 @@ def measure_spares_noop(steps: int = 6) -> dict:
         lh.shutdown()
     # steady state = every quorum after the first (which pays join timeout)
     steady = [t for times in lat.values() for t in times[1:]]
-    return {"spares_noop_quorum_ms": round(statistics.median(steady), 1)}
+    steady_rpc = [t for times in vote_rpc.values() for t in times[1:]]
+    steady_book = [t for times in bookkeeping.values() for t in times[1:]]
+    return {
+        "spares_noop_quorum_ms": round(statistics.median(steady), 1),
+        # per-step vote cost splits (Manager.timings()): the should_commit
+        # RPC itself vs. everything else left on the hot path
+        "spares_noop_vote_rpc_ms": round(statistics.median(steady_rpc), 3)
+        if steady_rpc
+        else None,
+        "spares_noop_bookkeeping_ms": round(statistics.median(steady_book), 3)
+        if steady_book
+        else None,
+    }
 
 
 _RESTART_WORKER = """\

@@ -24,22 +24,17 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def train(args) -> None:
-    if args.virtual_chips:
-        # local multi-process runs share no TPU; use a virtual CPU platform
-        from torchft_tpu.utils import force_virtual_cpu_devices
+def build_trainer(replica_id: int = 0, batch_size: int = 8, lr: float = 0.01):
+    """The example's model/optimizer/step, importable as a unit.
 
-        force_virtual_cpu_devices(args.virtual_chips)
+    Returns ``(state, grad_fn, optimizer, make_batch)`` so harnesses can run
+    the REAL trainer loop this example trains (benchmarks/ft_overhead_bench.py
+    measures its per-step cost bare vs. under a live Manager).
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
-
-    from torchft_tpu.manager import Manager
-    from torchft_tpu.process_group import ProcessGroupHost
-
-    replica_id = int(os.environ.get("REPLICA_GROUP_ID", args.replica_id))
-    lighthouse = os.environ.get("TORCHFT_LIGHTHOUSE", args.lighthouse)
 
     # -- model: tiny CNN on 32x32x3 inputs --------------------------------
     def init_params(key):
@@ -68,10 +63,41 @@ def train(args) -> None:
 
     # Different init per replica: init_sync recovers everyone from the primary.
     params = init_params(jax.random.PRNGKey(replica_id))
-    optimizer = optax.sgd(args.lr, momentum=0.9)
+    optimizer = optax.sgd(lr, momentum=0.9)
     opt_state = optimizer.init(params)
-
     state = {"params": params, "opt_state": opt_state}
+
+    rng = np.random.RandomState(replica_id)
+
+    def make_batch():
+        # synthetic batch, sharded per replica (DistributedSampler equivalent)
+        x = jnp.asarray(rng.randn(batch_size, 32, 32, 3), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 10, size=(batch_size,)))
+        return x, y
+
+    return state, grad_fn, optimizer, make_batch
+
+
+def train(args) -> None:
+    if args.virtual_chips:
+        # local multi-process runs share no TPU; use a virtual CPU platform
+        from torchft_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(args.virtual_chips)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    replica_id = int(os.environ.get("REPLICA_GROUP_ID", args.replica_id))
+    lighthouse = os.environ.get("TORCHFT_LIGHTHOUSE", args.lighthouse)
+
+    state, grad_fn, optimizer, _make_batch = build_trainer(
+        replica_id, args.batch_size, args.lr
+    )
+    opt_state = state["opt_state"]
 
     def load_state(sd):
         state["params"] = jax.tree_util.tree_map(jnp.asarray, sd["params"])

@@ -1,7 +1,9 @@
 """`python bench.py --smoke` is the CI gate for the overlapped-quorum
-plumbing: a tiny device-plane FT row must produce the per-phase timing
+plumbing: a tiny virtual-device FT row must produce the per-phase timing
 keys end to end (async quorum overlap, prepare/commit split, chunked
-heal)."""
+heal). `--ft-overhead --smoke` is the gate for the steady-state overhead
+harness: the real example trainer under a live Manager must emit
+ft_overhead_pct plus the per-phase cost splits."""
 
 import json
 import os
@@ -13,9 +15,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_smoke_emits_overlap_metrics():
+def _run_bench(*argv):
     proc = subprocess.run(
-        [sys.executable, "bench.py", "--smoke"],
+        [sys.executable, "bench.py", *argv],
         cwd=REPO,
         capture_output=True,
         text=True,
@@ -23,17 +25,33 @@ def test_bench_smoke_emits_overlap_metrics():
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, (
-        f"bench --smoke failed\nstdout:\n{proc.stdout[-2000:]}"
+        f"bench {' '.join(argv)} failed\nstdout:\n{proc.stdout[-2000:]}"
         f"\nstderr:\n{proc.stderr[-2000:]}"
     )
     lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
     assert lines, f"no JSON record in smoke output:\n{proc.stdout[-2000:]}"
-    rec = json.loads(lines[-1])
+    return json.loads(lines[-1])
+
+
+def test_bench_smoke_emits_overlap_metrics():
+    rec = _run_bench("--smoke")
     # the smoke run itself asserts these are present and sane; re-check the
     # load-bearing ones here so a silently-weakened smoke() still fails CI
-    assert rec["ft_device_quorum_overlap_s"] > 0
-    assert rec["ft_device_configure_prepare_s"] is not None
-    assert rec["ft_device_configure_commit_s"] is not None
-    assert rec["ft_device_heal_chunks"] >= 1
-    assert rec["ft_device_heal_mb_per_s"] > 0
-    assert rec["ft_device_recovery_s"] > 0
+    assert rec["ft_virtual_quorum_overlap_s"] > 0
+    assert rec["ft_virtual_configure_prepare_s"] is not None
+    assert rec["ft_virtual_configure_commit_s"] is not None
+    assert rec["ft_virtual_heal_chunks"] >= 1
+    assert rec["ft_virtual_heal_mb_per_s"] > 0
+    assert rec["ft_virtual_recovery_s"] > 0
+
+
+def test_bench_ft_overhead_smoke_emits_cost_splits():
+    rec = _run_bench("--ft-overhead", "--smoke")
+    assert rec["ft_overhead_pct"] is not None
+    assert rec["bare_step_s"] > 0
+    assert rec["ft_step_s"] > 0
+    # the per-phase splits prove Manager.timings() measured the hot loop,
+    # not just that the harness ran
+    assert rec["allreduce_s"] > 0
+    assert rec["should_commit_rpc_s"] > 0
+    assert rec["bookkeeping_s"] >= 0

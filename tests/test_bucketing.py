@@ -1,0 +1,173 @@
+"""Shared bucketing layer (torchft_tpu/bucketing.py) + the collective-count
+CI guard: a many-leaf pytree through Manager.allreduce must hit the process
+group with at most ceil(total_bytes / cap) flat arrays — the whole point of
+bucketing — and bitwise-identical values either way."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from test_manager import make_manager, make_quorum
+from torchft_tpu import bucketing
+from torchft_tpu.process_group import ProcessGroupDummy, ReduceOp
+
+
+class TestBufferPool:
+    def test_acquire_release_reuses_buffer(self):
+        pool = bucketing.BufferPool()
+        a = pool.acquire(16, np.float32)
+        assert pool.misses == 1 and pool.hits == 0
+        pool.release(a)
+        b = pool.acquire(16, np.float32)
+        assert b is a
+        assert pool.hits == 1
+
+    def test_key_is_dtype_and_size(self):
+        pool = bucketing.BufferPool()
+        a = pool.acquire(16, np.float32)
+        pool.release(a)
+        assert pool.acquire(16, np.float64) is not a
+        assert pool.acquire(8, np.float32) is not a
+
+    def test_max_per_key_caps_retention(self):
+        pool = bucketing.BufferPool(max_per_key=1)
+        a, b = pool.acquire(4, np.float32), pool.acquire(4, np.float32)
+        pool.release(a)
+        pool.release(b)  # beyond the cap: dropped, not retained
+        assert pool.acquire(4, np.float32) is a
+        c = pool.acquire(4, np.float32)
+        assert c is not a and c is not b
+
+
+class TestPlanCache:
+    def test_plan_for_memoizes_on_treedef_and_spec(self):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            {"a": np.ones(3, np.float32), "b": np.ones(5, np.float32)}
+        )
+        p1 = bucketing.plan_for(leaves, 1 << 20, treedef=treedef)
+        p2 = bucketing.plan_for(leaves, 1 << 20, treedef=treedef)
+        assert p2 is p1  # cache hit: the identical plan object
+        assert bucketing.plan_for(leaves, 1 << 10, treedef=treedef) is not p1
+
+    def test_same_structure_different_geometry_gets_new_plan(self):
+        _, treedef = jax.tree_util.tree_flatten({"a": 0, "b": 0})
+        small = [np.ones(3, np.float32), np.ones(5, np.float32)]
+        big = [np.ones(7, np.float32), np.ones(9, np.float32)]
+        p_small = bucketing.plan_for(small, 1 << 20, treedef=treedef)
+        p_big = bucketing.plan_for(big, 1 << 20, treedef=treedef)
+        assert p_big is not p_small
+        assert p_big.sizes != p_small.sizes
+
+
+class TestPackUnpackRoundtrip:
+    def test_host_roundtrip_bitwise(self):
+        rng = np.random.RandomState(0)
+        leaves = [
+            rng.randn(4, 3).astype(np.float32),
+            rng.randn(7).astype(np.float32),
+            rng.randn(2, 2).astype(np.float64),
+        ]
+        plan = bucketing.build_plan(leaves, 1 << 20)
+        assert len(plan) == 2  # one bucket per dtype
+        flats, pooled = bucketing.pack(leaves, plan)
+        assert not pooled  # no pool passed
+        out = bucketing.unpack(flats, plan)
+        for orig, got in zip(leaves, out):
+            assert got.shape == orig.shape and got.dtype == orig.dtype
+            np.testing.assert_array_equal(np.asarray(got), orig)
+
+    def test_device_groups_pack_as_jax_arrays(self):
+        leaves = [jnp.arange(4, dtype=jnp.float32), jnp.ones(3, jnp.float32)]
+        plan = bucketing.build_plan(leaves, 1 << 20)
+        flats, _ = bucketing.pack(leaves, plan)
+        assert len(flats) == 1 and isinstance(flats[0], jax.Array)
+        out = bucketing.unpack(flats, plan)
+        np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4))
+        np.testing.assert_array_equal(np.asarray(out[1]), np.ones(3))
+
+    def test_pack_into_pool_buffer(self):
+        pool = bucketing.BufferPool()
+        leaves = [np.ones(3, np.float32), np.full(5, 2.0, np.float32)]
+        plan = bucketing.build_plan(leaves, 1 << 20)
+        flats, pooled = bucketing.pack(leaves, plan, pool=pool)
+        assert pooled == [flats[0]]
+        np.testing.assert_array_equal(
+            flats[0], np.array([1, 1, 1, 2, 2, 2, 2, 2], np.float32)
+        )
+
+    def test_oversized_leaf_gets_own_bucket(self):
+        leaves = [np.ones(100, np.float32), np.ones(2, np.float32)]
+        plan = bucketing.build_plan(leaves, cap_bytes=16)
+        assert len(plan) == 2  # leaf 0 alone exceeds the cap; never dropped
+
+
+class CountingPG(ProcessGroupDummy):
+    """World-1 passthrough PG that records how many arrays each collective
+    carried — the observable the CI guard asserts on."""
+
+    def __init__(self):
+        super().__init__()
+        self.allreduce_calls = []
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        arrays = list(arrays)
+        self.allreduce_calls.append(len(arrays))
+        return super().allreduce(arrays, op)
+
+    @property
+    def total_arrays(self):
+        return sum(self.allreduce_calls)
+
+
+def _many_leaf_tree(n=100, size=17):
+    return {f"p{i}": np.full((size,), float(i), np.float32) for i in range(n)}
+
+
+class TestCollectiveCountGuard:
+    """CI guard (deterministic, tier-1): bucketing must actually reduce the
+    number of arrays hitting the wire, and must not change the values."""
+
+    def _reduce(self, tree, **manager_kwargs):
+        pg = CountingPG()
+        m = make_manager(pg=pg, quorum=make_quorum(), **manager_kwargs)
+        m.start_quorum()
+        out = m.allreduce(tree).get_future().wait(timeout=30)
+        return pg, out
+
+    def test_100_leaf_tree_is_one_collective_at_default_cap(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_BUCKET_CAP_MB", raising=False)
+        tree = _many_leaf_tree()
+        pg, out = self._reduce(tree)
+        # all float32, far under 1 GiB -> a single flat bucket
+        assert pg.total_arrays == 1
+        for i in range(100):
+            np.testing.assert_allclose(out[f"p{i}"], i / 2.0)  # avg of 2
+
+    def test_array_count_bounded_by_ceil_bytes_over_cap(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_BUCKET_CAP_MB", raising=False)
+        tree = _many_leaf_tree()
+        cap = 1024
+        total_bytes = sum(v.nbytes for v in tree.values())
+        pg, out = self._reduce(tree, bucket_cap_bytes=cap)
+        bound = math.ceil(total_bytes / cap)
+        assert 1 < pg.total_arrays <= bound, (
+            f"{pg.total_arrays} arrays for {total_bytes}B at cap={cap} "
+            f"(bound {bound})"
+        )
+        np.testing.assert_allclose(out["p7"], 3.5)
+
+    def test_cap_zero_disables_bucketing(self, monkeypatch):
+        monkeypatch.delenv("TORCHFT_BUCKET_CAP_MB", raising=False)
+        tree = _many_leaf_tree(n=10)
+        pg, out = self._reduce(tree, bucket_cap_bytes=0)
+        assert pg.total_arrays == 10  # per-leaf, unbucketed
+        np.testing.assert_allclose(out["p4"], 2.0)
+
+    def test_env_var_overrides_cap(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_BUCKET_CAP_MB", "0")
+        tree = _many_leaf_tree(n=10)
+        pg, _ = self._reduce(tree, bucket_cap_bytes=1 << 30)
+        assert pg.total_arrays == 10

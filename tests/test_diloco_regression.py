@@ -71,6 +71,8 @@ def run_diloco(
     fail_allreduce_at_step: "int | None" = None,
     use_bucketization: "bool | None" = None,
     bucket_cap_mb: "int | None" = None,
+    should_quantize: bool = False,
+    varied_grads: bool = False,
 ) -> "list[dict[str, list[float]]]":
     params = {
         "w0": np.arange(4, dtype=np.float32) / 4.0,
@@ -104,11 +106,20 @@ def run_diloco(
             fragment_update_alpha=fragment_update_alpha,
             use_bucketization=use_bucketization,
             bucket_cap_mb=bucket_cap_mb,
+            should_quantize=should_quantize,
         )
+        def inner_grad(v):
+            if not varied_grads:
+                return GRAD
+            # per-element spread so fp8 rowwise quantization actually rounds
+            # (a constant gradient is exactly representable after scaling)
+            n = v.shape[0]
+            return GRAD + 0.05 * (np.arange(n, dtype=np.float32) - n / 2.0)
+
         history = []
         for step in range(STEPS):
             state["params"] = {
-                k: v - INNER_LR * GRAD for k, v in state["params"].items()
+                k: v - INNER_LR * inner_grad(v) for k, v in state["params"].items()
             }
             if fail_allreduce_at_step is not None and step == fail_allreduce_at_step:
                 pg.report_future_error(RuntimeError("injected allreduce failure"))

@@ -736,3 +736,49 @@ class TestStateDictLock:
         m.allow_state_dict_read()
         t.join(5)
         assert not t.is_alive() and got
+
+
+class AutoModePG(ProcessGroupDummy):
+    """PG that can't know whether it needs sync quorum until its first
+    configure resolves the mode (auto-mode backends)."""
+
+    def __init__(self):
+        super().__init__()
+        self.resolved = False
+
+    @property
+    def requires_sync_quorum(self):
+        return not self.resolved
+
+    def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
+        super().configure(store_addr, replica_rank, replica_world_size, quorum_id)
+        self.resolved = True
+
+
+class TestAutoModeSyncQuorumTax:
+    def test_async_quorum_restored_after_configure_resolves(self):
+        """Sampling requires_sync_quorum once at construction would tax
+        every later step with a synchronous quorum RPC; the Manager must
+        re-evaluate per start_quorum and hand async quorum back."""
+        pg = AutoModePG()
+        m = make_manager(pg=pg, quorum=make_quorum(), use_async_quorum=True)
+        assert m._use_async_quorum is False  # safety valve at construction
+
+        m.start_quorum()  # sync quorum: configure runs, mode resolves
+        m.wait_quorum()
+        assert pg.resolved
+        assert m.should_commit()
+
+        m.start_quorum()  # re-evaluation point
+        assert m._use_async_quorum is True
+        m.wait_quorum()
+        assert m.should_commit()
+
+    def test_sync_requested_caller_never_flips(self):
+        pg = AutoModePG()
+        m = make_manager(pg=pg, quorum=make_quorum(), use_async_quorum=False)
+        m.start_quorum()
+        m.wait_quorum()
+        assert pg.resolved
+        m.start_quorum()
+        assert m._use_async_quorum is False  # caller chose sync; honor it

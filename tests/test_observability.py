@@ -83,3 +83,54 @@ def test_manager_events_emitted_on_report_error(caplog):
     assert payload["step"] == 5
     assert "injected" in payload["error"]
     assert m.errored() is not None
+
+
+class TestEventDrain:
+    def test_flush_inline_without_worker(self, caplog):
+        from torchft_tpu.observability import COMMIT_EVENTS, EventDrain
+
+        drain = EventDrain(autostart=False)
+        for i in range(3):
+            assert drain.submit(COMMIT_EVENTS, {"step": i, "committed": True})
+        with caplog.at_level(logging.INFO, logger=COMMIT_EVENTS):
+            assert drain.flush()
+        records = [r for r in caplog.records if r.name == COMMIT_EVENTS]
+        assert [json.loads(r.getMessage())["step"] for r in records] == [0, 1, 2]
+
+    def test_worker_drains_and_flush_blocks_until_written(self, caplog):
+        from torchft_tpu.observability import TIMING_EVENTS, EventDrain
+
+        drain = EventDrain()
+        with caplog.at_level(logging.INFO, logger=TIMING_EVENTS):
+            for i in range(5):
+                assert drain.submit(TIMING_EVENTS, {"phase": "t", "i": i})
+            assert drain.flush(timeout=10)
+        records = [r for r in caplog.records if r.name == TIMING_EVENTS]
+        assert len(records) == 5
+
+    def test_overflow_drops_newest_and_counts(self):
+        from torchft_tpu.observability import COMMIT_EVENTS, EventDrain
+
+        drain = EventDrain(maxsize=2, autostart=False)
+        assert drain.submit(COMMIT_EVENTS, {"step": 0})
+        assert drain.submit(COMMIT_EVENTS, {"step": 1})
+        assert not drain.submit(COMMIT_EVENTS, {"step": 2})  # full: dropped
+        assert drain.dropped == 1
+        # the queued (oldest) events survive; the overflow event is gone
+        assert drain.flush()
+
+    def test_bad_event_does_not_kill_drain(self, caplog):
+        from torchft_tpu.observability import COMMIT_EVENTS, EventDrain
+
+        drain = EventDrain(autostart=False)
+        drain.submit(COMMIT_EVENTS, {"bad": object()})  # default=str handles it
+        drain.submit(COMMIT_EVENTS, {"step": 1})
+        with caplog.at_level(logging.INFO, logger=COMMIT_EVENTS):
+            assert drain.flush()
+        records = [r for r in caplog.records if r.name == COMMIT_EVENTS]
+        assert len(records) == 2
+
+    def test_process_wide_singleton(self):
+        from torchft_tpu.observability import get_event_drain
+
+        assert get_event_drain() is get_event_drain()

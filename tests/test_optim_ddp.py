@@ -59,13 +59,35 @@ class TestDDP:
         assert m.allreduce.call_count == 1
         np.testing.assert_allclose(out["a"], 1.0)
 
-    def test_pure_ddp_per_leaf(self):
+    def test_pure_ddp_buckets_same_dtype(self):
+        # same-dtype leaves pack into ONE flat bucket -> one collective
         m = mock_manager()
         ddp = PureDistributedDataParallel(m)
         grads = {"a": np.ones(2), "b": np.zeros(3)}
         out = ddp.average_gradients(grads)
+        assert m.allreduce.call_count == 1
+        np.testing.assert_allclose(out["a"], 1.0)
+        np.testing.assert_allclose(out["b"], 0.0)
+
+    def test_pure_ddp_bucket_per_dtype_and_cap(self):
+        # mixed dtypes cannot share a flat buffer -> one bucket each; a
+        # tiny cap splits same-dtype leaves back into per-leaf collectives
+        m = mock_manager()
+        ddp = PureDistributedDataParallel(m)
+        grads = {
+            "a": np.ones(2, np.float32),
+            "b": np.zeros(3, np.float64),
+        }
+        out = ddp.average_gradients(grads)
         assert m.allreduce.call_count == 2
         np.testing.assert_allclose(out["b"], 0.0)
+
+        m2 = mock_manager()
+        ddp2 = PureDistributedDataParallel(m2, bucket_cap_bytes=4)
+        grads2 = {"a": np.ones(2, np.float32), "b": np.zeros(3, np.float32)}
+        out2 = ddp2.average_gradients(grads2)
+        assert m2.allreduce.call_count == 2
+        np.testing.assert_allclose(out2["a"], 1.0)
 
 
 class TestStatefulDataIterator:

@@ -21,6 +21,9 @@ _EXPORTS = {
     "ManagedProcessGroup": "torchft_tpu.process_group",
     "ProcessGroupXLA": "torchft_tpu.process_group_xla",
     "DistributedDataParallel": "torchft_tpu.ddp",
+    "PureDistributedDataParallel": "torchft_tpu.ddp",
+    "BucketPlan": "torchft_tpu.bucketing",
+    "BufferPool": "torchft_tpu.bucketing",
     "OptimizerWrapper": "torchft_tpu.optim",
     "LocalSGD": "torchft_tpu.local_sgd",
     "DiLoCo": "torchft_tpu.local_sgd",

@@ -18,7 +18,7 @@ import os
 import subprocess
 from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "QuorumMember",
@@ -405,10 +405,18 @@ class _RawClient:
         self.addr = addr
 
     def call(self, method: str, params: dict, timeout: "float | timedelta") -> dict:
+        return self.call_raw(method, json.dumps(params).encode(), timeout)
+
+    def call_raw(
+        self, method: str, params_json: bytes, timeout: "float | timedelta"
+    ) -> dict:
+        """Like :meth:`call` but takes the params frame pre-encoded —
+        per-step callers (the commit vote) build their frame once and
+        splice in what changes, skipping json.dumps on the hot path."""
         result = ctypes.c_char_p()
         err = ctypes.c_char_p()
         status = self._lib.tft_client_call(
-            self._handle, method.encode(), json.dumps(params).encode(),
+            self._handle, method.encode(), params_json,
             _ms(timeout), ctypes.byref(result), ctypes.byref(err),
         )
         err_s = _take_str(self._lib, err)
@@ -468,6 +476,11 @@ class ManagerClient:
 
     def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
         self._client = _RawClient(addr, connect_timeout)
+        # pre-built vote frames keyed by (group_rank, vote): everything but
+        # the step number is invariant across a training run, so the
+        # per-step should_commit only splices the step into a cached prefix
+        # instead of re-serializing the params dict (see should_commit)
+        self._vote_frames: Dict[Tuple[int, bool], bytes] = {}
 
     def _quorum(
         self,
@@ -504,10 +517,18 @@ class ManagerClient:
         should_commit: bool,
         timeout: "float | timedelta",
     ) -> bool:
-        resp = self._client.call(
-            "should_commit",
-            {"group_rank": group_rank, "step": step, "should_commit": should_commit},
-            timeout,
+        key = (group_rank, should_commit)
+        prefix = self._vote_frames.get(key)
+        if prefix is None:
+            # '{"group_rank": N, "should_commit": B}' -> strip the closing
+            # brace, leave a slot for the step: '...,"step":'
+            head = json.dumps(
+                {"group_rank": group_rank, "should_commit": should_commit}
+            ).encode()
+            prefix = head[:-1] + b', "step": '
+            self._vote_frames[key] = prefix
+        resp = self._client.call_raw(
+            "should_commit", prefix + str(step).encode() + b"}", timeout
         )
         return resp["should_commit"]
 

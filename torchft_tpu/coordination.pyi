@@ -1,0 +1,154 @@
+# Typed surface of the ctypes-backed coordination layer. The implementation
+# builds these classes around a native C++ library at import time, which type
+# checkers cannot see through; this stub pins the public API instead.
+
+from datetime import timedelta
+from typing import Dict, List, Optional
+
+_Timeout = float | timedelta
+
+def ensure_native_built() -> str: ...
+
+class QuorumMember:
+    replica_id: str
+    address: str
+    store_address: str
+    step: int
+    world_size: int
+    shrink_only: bool
+    commit_failures: int
+    data: str
+    def __init__(
+        self,
+        replica_id: str,
+        address: str = ...,
+        store_address: str = ...,
+        step: int = ...,
+        world_size: int = ...,
+        shrink_only: bool = ...,
+        commit_failures: int = ...,
+        data: str = ...,
+    ) -> None: ...
+
+class Quorum:
+    quorum_id: int
+    participants: List[QuorumMember]
+    created_ms: int
+    def __init__(
+        self,
+        quorum_id: int,
+        participants: List[QuorumMember],
+        created_ms: int = ...,
+    ) -> None: ...
+
+class QuorumResult:
+    quorum_id: int
+    replica_rank: int
+    replica_world_size: int
+    recover_src_manager_address: str
+    recover_src_replica_rank: Optional[int]
+    recover_dst_replica_ranks: List[int]
+    store_address: str
+    max_step: int
+    max_replica_rank: Optional[int]
+    max_world_size: int
+    heal: bool
+    commit_failures: int
+    replica_ids: List[str]
+    def __init__(
+        self,
+        quorum_id: int,
+        replica_rank: int,
+        replica_world_size: int,
+        recover_src_manager_address: str,
+        recover_src_replica_rank: Optional[int],
+        recover_dst_replica_ranks: List[int],
+        store_address: str,
+        max_step: int,
+        max_replica_rank: Optional[int],
+        max_world_size: int,
+        heal: bool,
+        commit_failures: int = ...,
+        replica_ids: List[str] = ...,
+    ) -> None: ...
+
+class LighthouseServer:
+    def __init__(
+        self,
+        bind: str = ...,
+        min_replicas: int = ...,
+        join_timeout_ms: int = ...,
+        quorum_tick_ms: int = ...,
+        heartbeat_timeout_ms: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    @property
+    def port(self) -> int: ...
+    def shutdown(self) -> None: ...
+
+class ManagerServer:
+    def __init__(
+        self,
+        replica_id: str,
+        lighthouse_addr: str,
+        hostname: str = ...,
+        bind: str = ...,
+        store_addr: str = ...,
+        world_size: int = ...,
+        heartbeat_interval: _Timeout = ...,
+        connect_timeout: _Timeout = ...,
+        quorum_retries: int = ...,
+    ) -> None: ...
+    def address(self) -> str: ...
+    @property
+    def port(self) -> int: ...
+    def shutdown(self) -> None: ...
+
+class KvStoreServer:
+    def __init__(self, bind: str = ...) -> None: ...
+    @property
+    def port(self) -> int: ...
+    def address(self) -> str: ...
+    def shutdown(self) -> None: ...
+
+class LighthouseClient:
+    def __init__(self, addr: str, connect_timeout: _Timeout = ...) -> None: ...
+    def quorum(
+        self,
+        replica_id: str,
+        timeout: _Timeout,
+        address: str = ...,
+        store_address: str = ...,
+        step: int = ...,
+        world_size: int = ...,
+        shrink_only: bool = ...,
+        data: Optional[Dict] = ...,
+        commit_failures: int = ...,
+    ) -> Quorum: ...
+    def heartbeat(self, replica_id: str, timeout: _Timeout = ...) -> None: ...
+    def status(self, timeout: _Timeout = ...) -> dict: ...
+
+class ManagerClient:
+    def __init__(self, addr: str, connect_timeout: _Timeout = ...) -> None: ...
+    def should_commit(
+        self,
+        group_rank: int,
+        step: int,
+        should_commit: bool,
+        timeout: _Timeout,
+    ) -> bool: ...
+    def kill(self, msg: str = ..., timeout: _Timeout = ...) -> None: ...
+
+class KvClient:
+    def __init__(self, addr: str, connect_timeout: _Timeout = ...) -> None: ...
+    def set(self, key: str, value: bytes | str, timeout: _Timeout = ...) -> None: ...
+    def get(self, key: str, timeout: _Timeout = ..., wait: bool = ...) -> bytes: ...
+    def add(self, key: str, amount: int, timeout: _Timeout = ...) -> int: ...
+    def check(self, keys: List[str], timeout: _Timeout = ...) -> bool: ...
+    def delete(self, key: str, timeout: _Timeout = ...) -> bool: ...
+    def num_keys(self, timeout: _Timeout = ...) -> int: ...
+
+def quorum_compute(state: dict, opts: dict) -> dict: ...
+def compute_quorum_results(
+    replica_id: str, group_rank: int, quorum: dict, init_sync: bool = ...
+) -> QuorumResult: ...

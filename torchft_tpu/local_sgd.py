@@ -174,73 +174,15 @@ def partition_fragments(leaves: Sequence[Any], num_fragments: int) -> List[List[
     return [f for f in frags if f]
 
 
-# 1 GiB default bucket cap (reference: local_sgd.py:176)
-DEFAULT_BUCKET_CAP_BYTES = 1 << 30
-
-
-def _make_buckets(arrays: List[Any], cap_bytes: int) -> List[tuple]:
-    """Pack arrays into flat same-dtype buckets of at most ``cap_bytes``.
-
-    Returns ``[(flat_buffer, metas), ...]`` with ``metas = [(arr_index,
-    offset, size, shape), ...]``. Fewer, larger collectives amortize the
-    per-op framing/pickling overhead of the host DCN plane — the same
-    motivation as the reference's bucketized allreduce (local_sgd.py:498-566),
-    minus the NCCL-launch angle which does not exist on TPU. jax.Array inputs
-    are packed on device (one fused concatenate, no host round-trip).
-    """
-    by_dtype: Dict[Any, List[int]] = {}
-    for i, a in enumerate(arrays):
-        by_dtype.setdefault(a.dtype, []).append(i)
-    # group indices first, pack after: no mutable-closure ordering traps
-    groups: List[List[int]] = []
-    for idxs in by_dtype.values():
-        cur: List[int] = []
-        cur_bytes = 0
-        for i in idxs:
-            nbytes = _nbytes(arrays[i])
-            if cur and cur_bytes + nbytes > cap_bytes:
-                groups.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(i)
-            cur_bytes += nbytes
-        if cur:
-            groups.append(cur)
-    return [_pack_bucket(arrays, g) for g in groups]
-
-
-def _pack_bucket(arrays: List[Any], idxs: List[int]) -> tuple:
-    import jax
-
-    metas = []
-    offset = 0
-    for i in idxs:
-        a = arrays[i]
-        metas.append((i, offset, a.size, a.shape))
-        offset += a.size
-    if all(isinstance(arrays[i], jax.Array) for i in idxs):
-        import jax.numpy as jnp
-
-        flat = jnp.concatenate([arrays[i].reshape(-1) for i in idxs])
-    else:
-        flat = np.empty(offset, dtype=arrays[idxs[0]].dtype)
-        for (i, off, size, _shape) in metas:
-            flat[off : off + size] = np.asarray(arrays[i]).reshape(-1)
-    return flat, metas
-
-
-def _unpack_buckets(
-    buckets_out: List[Any], bucket_metas: List[List[tuple]], n: int
-) -> List[Any]:
-    import jax
-
-    out: List[Optional[Any]] = [None] * n
-    for flat, metas in zip(buckets_out, bucket_metas):
-        if not isinstance(flat, jax.Array):
-            flat = np.asarray(flat)
-        for (i, off, size, shape) in metas:
-            out[i] = flat[off : off + size].reshape(shape)
-    assert all(o is not None for o in out)
-    return out  # type: ignore[return-value]
+# Bucketing lives in the shared torchft_tpu/bucketing.py (used by
+# Manager.allreduce and ddp.py as well); the underscore names are the
+# original home of these helpers, kept importable for callers and tests.
+from torchft_tpu.bucketing import (  # noqa: E402
+    DEFAULT_BUCKET_CAP_BYTES,
+    make_buckets as _make_buckets,
+    pack_group as _pack_bucket,
+    unpack_buckets as _unpack_buckets,
+)
 
 
 class _Fragment:

@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
+from torchft_tpu import bucketing
 from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport, RWLock
 from torchft_tpu.coordination import (
     KvClient,
@@ -45,10 +46,11 @@ from torchft_tpu.coordination import (
 )
 from torchft_tpu.futures import future_timeout
 from torchft_tpu.observability import (
-    log_commit_event,
+    COMMIT_EVENTS,
+    TIMING_EVENTS,
+    emit_event_async,
     log_error_event,
     log_quorum_event,
-    log_timing_event,
     trace_span,
     traced,
 )
@@ -68,6 +70,9 @@ TIMEOUT_SEC_ENV = "TORCHFT_TIMEOUT_SEC"
 QUORUM_TIMEOUT_SEC_ENV = "TORCHFT_QUORUM_TIMEOUT_SEC"
 CONNECT_TIMEOUT_SEC_ENV = "TORCHFT_CONNECT_TIMEOUT_SEC"
 QUORUM_RETRIES_ENV = "TORCHFT_QUORUM_RETRIES"
+# bucket cap for the managed allreduce's bucketed path, in MiB; 0 disables
+# bucketing entirely (per-leaf collectives, the pre-bucketing behavior)
+BUCKET_CAP_MB_ENV = "TORCHFT_BUCKET_CAP_MB"
 
 
 def _to_seconds(t: "float | timedelta") -> float:
@@ -103,6 +108,9 @@ class _ManagerLogger:
 
     def _prefix(self) -> str:
         return f"[{self._replica_id}/{self._group_rank} - step {self._manager._step}]"
+
+    def debug(self, msg: str) -> None:
+        logger.debug(f"{self._prefix()} {msg}")
 
     def info(self, msg: str) -> None:
         self._logger.info(f"{self._prefix()} {msg}")
@@ -155,10 +163,16 @@ class Manager:
         quorum_retries: Optional[int] = None,
         heartbeat_interval: "float | timedelta" = 0.1,
         hostname: str = "",
+        bucket_cap_bytes: Optional[int] = None,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
         self._use_async_quorum = use_async_quorum
+        # the mode the CALLER asked for: the requires_sync_quorum override
+        # below is re-evaluated per step (start_quorum) so an auto-mode PG
+        # that stops requiring sync quorum once configure resolves its mode
+        # gets async quorum back — but never a caller who chose sync
+        self._requested_async_quorum = use_async_quorum
         if use_async_quorum and getattr(pg, "requires_sync_quorum", False):
             # Safety valve for PGs WITHOUT a prepare/commit configure
             # split that still rebuild global device state inside
@@ -263,6 +277,28 @@ class Manager:
 
         self._store_addr = store_addr
         self._client = ManagerClient(manager_addr, connect_timeout=self._connect_timeout)
+        # Dedicated client for the per-step commit vote: the native RPC
+        # client keeps ONE cached keep-alive connection per handle, and a
+        # call that arrives while another thread holds it falls back to a
+        # one-shot connect. The quorum thread's RPC is in flight exactly
+        # when the main thread votes (async quorum), so sharing a handle
+        # would put a TCP connect on the hot path every overlapped step.
+        self._vote_client = ManagerClient(
+            manager_addr, connect_timeout=self._connect_timeout
+        )
+
+        # bucketed managed allreduce: cap resolution order is env var >
+        # constructor > default; 0 disables (per-leaf collectives)
+        env_cap = os.environ.get(BUCKET_CAP_MB_ENV)
+        if env_cap is not None:
+            self._bucket_cap_bytes = int(float(env_cap) * 1024 * 1024)
+        elif bucket_cap_bytes is not None:
+            self._bucket_cap_bytes = int(bucket_cap_bytes)
+        else:
+            self._bucket_cap_bytes = bucketing.DEFAULT_BUCKET_CAP_BYTES
+        # host staging buffers recycle through the pool instead of
+        # allocating a gradient-sized buffer per step
+        self._buffer_pool = bucketing.BufferPool()
 
         self._step = 0
         self._quorum_id = -1
@@ -371,6 +407,21 @@ class Manager:
             # skipped should_commit after an error) must land before the
             # next prepare runs against the old world
             self._commit_pending_configure()
+
+        # Re-evaluate the construction-time sync-quorum override: an
+        # auto-mode PG can't know whether it needs sync quorum until its
+        # first configure resolves the mode, so sampling the property once
+        # at construction would tax every later step with a synchronous
+        # quorum RPC. Only the caller's requested mode is ever restored.
+        if (
+            self._requested_async_quorum
+            and not self._use_async_quorum
+            and not getattr(self._pg, "requires_sync_quorum", False)
+        ):
+            self._logger.info(
+                "pg no longer requires sync quorum; restoring async quorum"
+            )
+            self._use_async_quorum = True
 
         self._errored = None
         self._healing = False
@@ -682,8 +733,25 @@ class Manager:
         """
         import jax
 
+        t_allreduce0 = time.perf_counter()
         self._bump_metric("allreduces")
         leaves, treedef = jax.tree_util.tree_flatten(values)
+
+        # Bucketed path: pack a multi-leaf tree into a handful of flat
+        # same-dtype buffers (shared bucketing.py; plan cached by tree
+        # identity + leaf geometry) so the wire carries ceil(bytes/cap)
+        # collectives instead of one per leaf. The quantized path is NEVER
+        # pre-bucketed: collectives.py already concatenates into one flat
+        # wire buffer, and packing first would shift the fp8 rowwise-scale
+        # boundaries (changing numerics).
+        plan: Optional[bucketing.BucketPlan] = None
+        if not should_quantize and len(leaves) > 1 and self._bucket_cap_bytes > 0:
+            try:
+                plan = bucketing.plan_for(
+                    leaves, self._bucket_cap_bytes, treedef=treedef
+                )
+            except Exception:  # noqa: BLE001 — exotic leaves fall back per-leaf
+                plan = None
 
         def rebuild(host_leaves: List[np.ndarray]) -> Any:
             import jax.numpy as jnp
@@ -775,18 +843,37 @@ class Manager:
                 reduced = [
                     (r / num_participants).astype(_np_dtype(r)) for r in reduced
                 ]
+            if plan is not None:
+                # slice the reduced flats back into per-leaf arrays; rebuild
+                # then restores each ORIGINAL leaf's device placement
+                reduced = bucketing.unpack(reduced, plan)
             return rebuild(reduced)
 
         try:
             if device_native:
                 import jax.numpy as jnp
 
-                dev_leaves = [
-                    l if isinstance(l, jax.Array) else jnp.asarray(l)
-                    for l in leaves
-                ]
-                if not self.is_participating():
-                    dev_leaves = [jnp.zeros_like(h) for h in dev_leaves]
+                if plan is not None:
+                    if not self.is_participating():
+                        # zero contribution, built directly at bucket shape
+                        # (cheaper than zeroing per leaf then packing)
+                        dev_leaves = [
+                            jnp.zeros(size, dtype)
+                            for size, dtype in zip(plan.sizes, plan.dtypes)
+                        ]
+                    else:
+                        up = [
+                            l if isinstance(l, jax.Array) else jnp.asarray(l)
+                            for l in leaves
+                        ]
+                        dev_leaves, _ = bucketing.pack(up, plan)
+                else:
+                    dev_leaves = [
+                        l if isinstance(l, jax.Array) else jnp.asarray(l)
+                        for l in leaves
+                    ]
+                    if not self.is_participating():
+                        dev_leaves = [jnp.zeros_like(h) for h in dev_leaves]
                 if should_quantize:
                     from torchft_tpu.collectives import allreduce_quantized
 
@@ -817,14 +904,31 @@ class Manager:
                 import jax.numpy as jnp
 
                 if participating:
-                    capture = [
-                        jnp.copy(l) if isinstance(l, jax.Array)
-                        else np.array(l, copy=True)
-                        for l in leaves
-                    ]
+                    if plan is not None:
+                        # the packed flats ARE the capture: device groups
+                        # concatenate into a fresh (donation-safe) buffer,
+                        # host groups copy into a pool-recycled one — no
+                        # second per-leaf copy
+                        capture, pooled = bucketing.pack(
+                            leaves, plan, pool=self._buffer_pool
+                        )
+                    else:
+                        capture = [
+                            jnp.copy(l) if isinstance(l, jax.Array)
+                            else np.array(l, copy=True)
+                            for l in leaves
+                        ]
+                        pooled = []
                 else:
                     capture = None
-                zero_specs = [(np.shape(l), _np_dtype(l)) for l in leaves]
+                    pooled = []
+                if plan is not None:
+                    zero_specs = [
+                        ((size,), dtype)
+                        for size, dtype in zip(plan.sizes, plan.dtypes)
+                    ]
+                else:
+                    zero_specs = [(np.shape(l), _np_dtype(l)) for l in leaves]
                 stage_timeout = self._timeout
 
                 def _stage_deadline() -> None:
@@ -944,12 +1048,49 @@ class Manager:
 
                 staged_fut.add_done_callback(_unpin)
 
+                if pooled:
+                    pool = self._buffer_pool
+
+                    def _recycle(f: Future) -> None:
+                        # Recycle pooled staging buffers once the wire is
+                        # done — but only on success (an errored/timed-out
+                        # op's wire thread may still read its buffer), and
+                        # never a buffer the PG passed through as its own
+                        # result (world-1 short circuits): the caller's
+                        # rebuilt tree may hold views into it.
+                        try:
+                            if f.exception() is not None:
+                                return
+                            out = f.value()
+                        except Exception:  # noqa: BLE001
+                            return
+                        for b in pooled:
+                            if any(
+                                isinstance(o, np.ndarray)
+                                and np.shares_memory(o, b)
+                                for o in out
+                            ):
+                                continue
+                            pool.release(b)
+
+                    staged_fut.add_done_callback(_recycle)
+
             fut = fut.then(normalize)
             # device path: submission-time timer (op starts immediately).
             # host path: the stage-start watchdog above owns the deadline —
             # a submission timer would charge queue time behind an
             # in-flight quantized sync against this op.
             fut = self.wrap_future(fut, zeros, arm_timeout=device_native)
+
+            def _time_allreduce(_f: Future) -> None:
+                # submission → resolve wall clock of the most recent
+                # collective, for the steady-state budget split
+                # (ft_overhead harness; see timings())
+                self._record_timing(
+                    "allreduce_s", time.perf_counter() - t_allreduce0
+                )
+
+            fut.add_done_callback(_time_allreduce)
             return FutureWork(fut)
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"got exception in allreduce -- skipping remaining: {e}")
@@ -989,7 +1130,11 @@ class Manager:
 
     def _log_timing_snapshot(self, phase: str) -> None:
         try:
-            log_timing_event(
+            # through the bounded async drain: snapshots fire from the
+            # commit path (which serializes with the trainer), so the JSON
+            # encode + logging I/O must not ride the critical path
+            emit_event_async(
+                TIMING_EVENTS,
                 replica_id=self._replica_id,
                 group_rank=self._group_rank,
                 step=self._step,
@@ -1081,12 +1226,16 @@ class Manager:
         """Two-phase commit vote across the replica group; True iff every
         rank of this group is healthy and enough replicas participate
         (reference: manager.py:848-936)."""
+        t_begin = time.perf_counter()
         # recovery (on the quorum thread) must finish before we decide
         if self._quorum_future is not None:
             try:
                 self._quorum_future.result()
             except Exception as e:  # noqa: BLE001
                 self.report_error(e)
+        # time spent joining the quorum thread is overlap shortfall, not
+        # bookkeeping — split it out so the steady-state budget is honest
+        join_s = time.perf_counter() - t_begin
 
         # apply a pending backend swap BEFORE sampling pg.errored(): after
         # a membership change the OLD world is typically errored (the abort
@@ -1119,16 +1268,25 @@ class Manager:
                 f"min={self._min_replica_size}) "
                 f"errored={self._errored!r}"
             )
-        should_commit = self._client.should_commit(
+        # the vote rides its own warm client (see __init__) and a pre-built
+        # frame (coordination.py): the steady-state step is this one RPC
+        # round-trip plus the collective
+        t_rpc = time.perf_counter()
+        should_commit = self._vote_client.should_commit(
             self._group_rank,
             self._step,
             local_should_commit,
             timeout=_to_seconds(timeout) if timeout is not None else self._timeout,
         )
-        self._logger.info(
+        rpc_s = time.perf_counter() - t_rpc
+        # per-step outcome at DEBUG: the False cases already warn above /
+        # in the retry path, and the commit event below carries the full
+        # record — an INFO line per healthy step is pure hot-loop cost
+        self._logger.debug(
             f"should_commit={should_commit} enough_replicas={enough_replicas} errored={self._errored is not None}"
         )
-        log_commit_event(
+        emit_event_async(
+            COMMIT_EVENTS,
             replica_id=self._replica_id,
             group_rank=self._group_rank,
             step=self._step,
@@ -1160,6 +1318,11 @@ class Manager:
                 self._logger.exception(msg)
                 raise RuntimeError(msg)
 
+        self._record_timing("should_commit_rpc_s", rpc_s)
+        self._record_timing(
+            "bookkeeping_s",
+            max(0.0, time.perf_counter() - t_begin - rpc_s - join_s),
+        )
         return should_commit
 
     # -------------------------------------------------------- introspection
@@ -1292,6 +1455,14 @@ class Manager:
                 except RuntimeError:
                     pass
         self._pg.shutdown()
+        # best-effort: land any commit/timing events still queued in the
+        # async drain before the process (and its log handlers) go away
+        try:
+            from torchft_tpu.observability import get_event_drain
+
+            get_event_drain().flush(timeout=2.0)
+        except Exception:  # noqa: BLE001 — teardown must never raise
+            pass
 
     @property
     def store_addr(self) -> str:

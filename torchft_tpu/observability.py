@@ -26,9 +26,11 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue
+import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional, Tuple
 
 USE_OTEL_ENV = "TORCHFT_USE_OTEL"
 OTEL_RESOURCE_ATTRS_ENV = "TORCHFT_OTEL_RESOURCE_ATTRIBUTES_JSON"
@@ -140,6 +142,123 @@ def log_error_event(**fields: Any) -> None:
 
 def log_timing_event(**fields: Any) -> None:
     get_event_logger(TIMING_EVENTS).log(**fields)
+
+
+class EventDrain:
+    """Bounded async event emitter for hot-path callers.
+
+    The synchronous ``log_*`` functions above serialize + write on the
+    calling thread — fine for rare events (quorum changes, errors), but a
+    per-step caller (``Manager.should_commit``) would pay JSON encoding and
+    logging I/O on the training-critical path every step. ``submit`` only
+    enqueues; one daemon worker drains the queue through the same
+    :class:`EventLogger` streams (console + optional OTLP).
+
+    Bounded and lossy by design: when the queue is full the NEW event is
+    dropped and counted (``dropped``) rather than blocking the trainer —
+    observability must never become backpressure. ``flush`` waits until
+    everything queued so far has been written (e.g. before shutdown).
+    """
+
+    _FLUSH = "__flush__"
+
+    def __init__(self, maxsize: int = 1024, autostart: bool = True) -> None:
+        self._q: "queue.Queue[Tuple[str, Any]]" = queue.Queue(maxsize)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._autostart = autostart
+        self._dropped = 0
+
+    @property
+    def dropped(self) -> int:
+        """Events discarded because the queue was full."""
+        with self._lock:
+            return self._dropped
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._thread = threading.Thread(
+                target=self._run, name="torchft_event_drain", daemon=True
+            )
+            self._thread.start()
+
+    def _emit(self, stream: str, fields: Dict[str, Any]) -> None:
+        try:
+            get_event_logger(stream).log(**fields)
+        except Exception:  # noqa: BLE001 — a bad event must not kill the drain
+            logging.getLogger(__name__).exception(
+                "event drain failed to emit %s event", stream
+            )
+
+    def _run(self) -> None:
+        while True:
+            stream, payload = self._q.get()
+            try:
+                if stream == self._FLUSH:
+                    payload.set()
+                else:
+                    self._emit(stream, payload)
+            finally:
+                self._q.task_done()
+
+    def submit(self, stream: str, fields: Dict[str, Any]) -> bool:
+        """Enqueue an event; returns False (and counts a drop) if full."""
+        if self._autostart:
+            self.start()
+        try:
+            self._q.put_nowait((stream, dict(fields)))
+            return True
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+            return False
+
+    def flush(self, timeout: Optional[float] = 5.0) -> bool:
+        """Block until everything queued before this call is written.
+        With no live worker (autostart=False), drains inline instead."""
+        with self._lock:
+            alive = self._thread is not None and self._thread.is_alive()
+        if not alive:
+            while True:
+                try:
+                    stream, payload = self._q.get_nowait()
+                except queue.Empty:
+                    return True
+                try:
+                    if stream == self._FLUSH:
+                        payload.set()
+                    else:
+                        self._emit(stream, payload)
+                finally:
+                    self._q.task_done()
+        done = threading.Event()
+        try:
+            self._q.put((self._FLUSH, done), timeout=timeout)
+        except queue.Full:
+            return False
+        return done.wait(timeout)
+
+
+_event_drain: Optional[EventDrain] = None
+_event_drain_lock = threading.Lock()
+
+
+def get_event_drain() -> EventDrain:
+    """Process-wide drain shared by every hot-path emitter."""
+    global _event_drain
+    with _event_drain_lock:
+        if _event_drain is None:
+            _event_drain = EventDrain()
+        return _event_drain
+
+
+def emit_event_async(stream: str, **fields: Any) -> bool:
+    """Hot-path event emission: enqueue onto the bounded drain and return
+    immediately. Use the synchronous ``log_*`` helpers for rare events
+    whose loss at a crash would matter (errors)."""
+    return get_event_drain().submit(stream, fields)
 
 
 def traced(name: str):

@@ -1,0 +1,189 @@
+"""Streamed vs serial managed allreduce on the host loopback plane.
+
+PR 3's streaming bucket pipeline claims the managed allreduce stops paying
+pack → wire → unpack serially once buckets flow through the 3-stage
+pipeline (bucket i+1 packs while bucket i rides the wire and bucket i−1
+unpacks). This harness measures that claim instead of asserting it: two
+replica groups exchange the SAME multi-bucket gradient tree through real
+Managers (live lighthouse, per-step quorum + two-phase vote, loopback
+ProcessGroupHost) twice — once with ``stream_buckets=False`` (PR 2's
+monolithic path: one collective per plan, unpack after the LAST bucket's
+wire) and once with the streaming pipeline — and reports the median step
+walls side by side plus the pipeline's own stage splits
+(``allreduce_pack_s`` / ``wire_s`` / ``unpack_s``) and
+``overlap_efficiency`` (fraction of wire time hidden behind other buckets'
+stages) from ``Manager.timings()``.
+
+On the 1-vCPU bench hosts the win is cache locality + pipelining across
+the PG dispatch / staging / unpack threads, not parallel silicon — medians
+throughout, same policy as the other harnesses.
+
+    python benchmarks/allreduce_pipeline_bench.py [--size-mb 64] [--cap-mb 4]
+
+Prints one JSON line; ``bench.py --allreduce-pipeline`` runs it in a
+CPU-pinned subprocess and ``--allreduce-pipeline --smoke`` is the fast-tier
+CI gate (tests/test_bench_smoke.py) asserting the per-bucket split keys.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def _median(xs):
+    return statistics.median(xs) if xs else 0.0
+
+
+def _make_tree(size_mb: float, leaves: int) -> dict:
+    n_total = int(size_mb * (1 << 20)) // 4
+    per = max(1, n_total // leaves)
+    rng = np.random.RandomState(0)
+    return {
+        f"w{i}": rng.randn(per).astype(np.float32) for i in range(leaves)
+    }
+
+
+def _run_mode(
+    stream: bool, tree: dict, cap_bytes: int, steps: int, warmup: int
+) -> dict:
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=5000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+    )
+    barrier = threading.Barrier(2)
+    step_times: list = []
+    snaps: list = []
+    errors: list = []
+
+    def replica(rid: int) -> None:
+        manager = None
+        try:
+            manager = Manager(
+                pg=ProcessGroupHost(timeout=60.0),
+                load_state_dict=lambda sd: None,
+                state_dict=lambda: {"x": np.zeros(1, np.float32)},
+                min_replica_size=2,
+                replica_id=f"pipeline_{'stream' if stream else 'serial'}_{rid}",
+                lighthouse_addr=f"127.0.0.1:{lh.port}",
+                timeout=60.0,
+                bucket_cap_bytes=cap_bytes,
+                stream_buckets=stream,
+            )
+            for i in range(steps):
+                barrier.wait(timeout=180)
+                t0 = time.perf_counter()
+                manager.start_quorum()
+                if stream:
+                    manager.allreduce_streamed(tree).wait(timeout=120)
+                else:
+                    manager.allreduce(tree).get_future().wait(timeout=120)
+                if not manager.should_commit():
+                    errors.append(f"commit failed rid={rid} step={i}")
+                if rid == 0:
+                    step_times.append(time.perf_counter() - t0)
+                    if i >= warmup:
+                        snaps.append(manager.timings())
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"rid={rid}: {type(e).__name__}: {e}")
+            barrier.abort()
+        finally:
+            if manager is not None:
+                manager.shutdown(wait=False)
+
+    threads = [
+        threading.Thread(target=replica, args=(rid,), daemon=True)
+        for rid in (0, 1)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+    finally:
+        lh.shutdown()
+    if errors:
+        raise RuntimeError("; ".join(errors[:3]))
+
+    out = {"step_s": round(_median(step_times[warmup:]), 6)}
+    for key in (
+        "allreduce_s",
+        "allreduce_pack_s",
+        "allreduce_wire_s",
+        "allreduce_unpack_s",
+        "allreduce_buckets",
+        "overlap_efficiency",
+    ):
+        vals = [s[key] for s in snaps if key in s]
+        if vals:
+            out[key] = round(_median(vals), 6)
+    return out
+
+
+def run(
+    size_mb: float = 64,
+    leaves: int = 16,
+    cap_mb: float = 4,
+    steps: int = 10,
+    warmup: int = 3,
+) -> dict:
+    """Time the two-replica loopback exchange serial vs streamed.
+
+    Returns the serial/streamed median step walls, ``speedup_pct``
+    ((serial − streamed) / serial), and the streamed run's pipeline stage
+    splits + ``overlap_efficiency``.
+    """
+    from torchft_tpu.observability import log_timing_event
+
+    tree = _make_tree(size_mb, leaves)
+    cap_bytes = int(cap_mb * (1 << 20))
+
+    serial = _run_mode(False, tree, cap_bytes, steps, warmup)
+    streamed = _run_mode(True, tree, cap_bytes, steps, warmup)
+
+    serial_s, streamed_s = serial["step_s"], streamed["step_s"]
+    result = {
+        "serial_step_s": serial_s,
+        "streamed_step_s": streamed_s,
+        "speedup_pct": round((serial_s - streamed_s) / serial_s * 100.0, 2)
+        if serial_s > 0
+        else None,
+        "allreduce_pack_s": streamed.get("allreduce_pack_s"),
+        "allreduce_wire_s": streamed.get("allreduce_wire_s"),
+        "allreduce_unpack_s": streamed.get("allreduce_unpack_s"),
+        "allreduce_buckets": streamed.get("allreduce_buckets"),
+        "overlap_efficiency": streamed.get("overlap_efficiency"),
+        "serial_allreduce_s": serial.get("allreduce_s"),
+        "streamed_allreduce_s": streamed.get("allreduce_s"),
+        "size_mb": size_mb,
+        "leaves": leaves,
+        "cap_mb": cap_mb,
+        "steps": steps,
+    }
+    # ride the observability stream so fleet tooling sees the measured
+    # pipeline win next to the per-step allreduce_pipeline snapshots
+    log_timing_event(phase="allreduce_pipeline_bench",
+                     replica_id="pipeline_bench", **result)
+    return result
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--size-mb", type=float, default=64)
+    p.add_argument("--leaves", type=int, default=16)
+    p.add_argument("--cap-mb", type=float, default=4)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=3)
+    a = p.parse_args()
+    print(json.dumps(run(a.size_mb, a.leaves, a.cap_mb, a.steps, a.warmup)))

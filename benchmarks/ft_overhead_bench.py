@@ -96,7 +96,15 @@ def run(steps: int = 30, warmup: int = 5, batch_size: int = 8) -> dict:
         timeout=30.0,
     )
     ft_times = []
-    splits = {"allreduce_s": [], "should_commit_rpc_s": [], "bookkeeping_s": []}
+    splits = {
+        "allreduce_s": [],
+        "should_commit_rpc_s": [],
+        "bookkeeping_s": [],
+        # streamed-pipeline stage splits (see Manager._record_pipeline_timings)
+        "allreduce_wire_s": [],
+        "overlap_efficiency": [],
+        "allreduce_buckets": [],
+    }
     committed = 0
     try:
         for i in range(total):
@@ -131,6 +139,9 @@ def run(steps: int = 30, warmup: int = 5, batch_size: int = 8) -> dict:
         "allreduce_s": round(_median(splits["allreduce_s"]), 6),
         "should_commit_rpc_s": round(_median(splits["should_commit_rpc_s"]), 6),
         "bookkeeping_s": round(_median(splits["bookkeeping_s"]), 6),
+        "allreduce_wire_s": round(_median(splits["allreduce_wire_s"]), 6),
+        "overlap_efficiency": round(_median(splits["overlap_efficiency"]), 4),
+        "allreduce_buckets": _median(splits["allreduce_buckets"]),
         "steps": steps,
         "committed": committed,
         "batch_size": batch_size,

@@ -147,14 +147,36 @@ def _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id) -> No
     import jax.numpy as jnp
     import optax
 
+    accum = max(1, getattr(args, "grad_accum", 1))
     while manager.current_step() < args.steps:
         # synthetic batch, sharded per replica (DistributedSampler equivalent)
         x = jnp.asarray(rng.randn(args.batch_size, 32, 32, 3), jnp.float32)
         y = jnp.asarray(rng.randint(0, 10, size=(args.batch_size,)))
 
         manager.start_quorum()
-        loss, grads = grad_fn(state["params"], x, y)
-        reduced = manager.allreduce(grads).get_future().wait(timeout=60)
+        if accum > 1:
+            # Gradient accumulation over the streaming pipeline: each
+            # microbatch's streamed allreduce starts reducing its buckets
+            # while the NEXT microbatch's grad_fn runs, so the wire rides
+            # under compute. Allreduce is linear, so averaging the reduced
+            # microbatch means equals reducing the accumulated mean.
+            streams = []
+            for k in range(accum):
+                if k > 0:
+                    x = jnp.asarray(
+                        rng.randn(args.batch_size, 32, 32, 3), jnp.float32
+                    )
+                    y = jnp.asarray(rng.randint(0, 10, size=(args.batch_size,)))
+                loss, grads = grad_fn(state["params"], x, y)
+                streams.append(manager.allreduce_streamed(grads))
+            reduced_trees = [s.wait(timeout=60) for s in streams]
+            reduced = jax.tree_util.tree_map(
+                lambda *vs: sum(jnp.asarray(v) for v in vs) / len(vs),
+                *reduced_trees,
+            )
+        else:
+            loss, grads = grad_fn(state["params"], x, y)
+            reduced = manager.allreduce(grads).get_future().wait(timeout=60)
         if manager.should_commit():
             updates, new_opt_state = optimizer.update(
                 jax.tree_util.tree_map(jnp.asarray, reduced),
@@ -229,6 +251,10 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="microbatches per step; >1 issues one STREAMED "
+                             "allreduce per microbatch so bucket reduction "
+                             "overlaps the next microbatch's grad_fn")
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--transport", choices=["http", "pg"], default="http",

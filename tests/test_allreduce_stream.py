@@ -1,0 +1,336 @@
+"""Streaming bucket pipeline (Manager.allreduce_streamed / GradStream).
+
+Pins the PR-3 contracts: streamed numerics are BIT-identical to the serial
+path on both planes, a plan of k buckets issues exactly k single-array
+collectives, the staging worker never blocks on a bucket's wire completion,
+and a mid-stream bucket failure degrades to the swallowed-zeros +
+should_commit()==False story — never a partially-applied reduction.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_manager import make_manager, make_quorum
+from torchft_tpu import bucketing
+from torchft_tpu.manager import _covered_seconds, _pipeline_overlap_stats
+from torchft_tpu.process_group import (
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+    ReduceOp,
+)
+from torchft_tpu.work import Future, FutureWork, GradStream, join_futures
+
+
+def _tree(n=6, size=9, dtype=np.float32):
+    rng = np.random.RandomState(7)
+    return {
+        f"p{i}": rng.randn(size).astype(dtype) for i in range(n)
+    }
+
+
+class CountingPG(ProcessGroupDummy):
+    """World-1 passthrough recording how many arrays each collective took."""
+
+    def __init__(self):
+        super().__init__()
+        self.allreduce_calls = []
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        arrays = list(arrays)
+        self.allreduce_calls.append(len(arrays))
+        return super().allreduce(arrays, op)
+
+
+class GatedPG(ProcessGroupDummy):
+    """Passthrough whose allreduce futures resolve only when the test says —
+    the observable for 'staging dispatches bucket i+1 while bucket i is
+    still on the wire'."""
+
+    def __init__(self):
+        super().__init__()
+        self.pending = []  # (arrays, fut) in dispatch order
+        self.dispatched = threading.Condition()
+
+    def allreduce(self, arrays, op=ReduceOp.SUM):
+        fut = Future()
+        with self.dispatched:
+            self.pending.append(([np.asarray(a).copy() for a in arrays], fut))
+            self.dispatched.notify_all()
+        return FutureWork(fut)
+
+    def release_all(self):
+        with self.dispatched:
+            pending = list(self.pending)
+        for arrays, fut in pending:
+            fut.set_result(arrays)
+
+
+def _reduce(m, tree, streamed, **kw):
+    m.start_quorum()
+    if streamed:
+        return m.allreduce_streamed(tree, **kw).wait(timeout=30)
+    return m.allreduce(tree, **kw).get_future().wait(timeout=30)
+
+
+class TestStreamedSerialEquality:
+    def test_host_plane_bitwise_identical(self):
+        """Same tree through stream_buckets on/off: every leaf bitwise
+        equal, same dtype — the pipeline may not change numerics at all."""
+        tree = _tree()
+        cap = 2 * 9 * 4  # 2 leaves per bucket -> 3 buckets
+        serial = _reduce(
+            make_manager(quorum=make_quorum(), bucket_cap_bytes=cap,
+                         stream_buckets=False),
+            tree, streamed=False,
+        )
+        streamed = _reduce(
+            make_manager(quorum=make_quorum(), bucket_cap_bytes=cap,
+                         stream_buckets=True),
+            tree, streamed=True,
+        )
+        for k in tree:
+            s, t = np.asarray(serial[k]), np.asarray(streamed[k])
+            assert s.dtype == t.dtype
+            assert np.array_equal(s, t), f"leaf {k} diverged"
+
+    def test_device_plane_bitwise_identical(self):
+        """Device-native PGs take per-bucket jax arrays straight through;
+        the landed tree must still match the serial path bit for bit."""
+        import jax.numpy as jnp
+
+        class DeviceDummy(ProcessGroupDummy):
+            device_native = True
+
+        tree = {k: jnp.asarray(v) for k, v in _tree(n=5, size=8).items()}
+        cap = 2 * 8 * 4
+        serial = _reduce(
+            make_manager(pg=DeviceDummy(), quorum=make_quorum(),
+                         bucket_cap_bytes=cap, stream_buckets=False),
+            tree, streamed=False,
+        )
+        streamed = _reduce(
+            make_manager(pg=DeviceDummy(), quorum=make_quorum(),
+                         bucket_cap_bytes=cap, stream_buckets=True),
+            tree, streamed=True,
+        )
+        for k in tree:
+            s, t = np.asarray(serial[k]), np.asarray(streamed[k])
+            assert s.dtype == t.dtype
+            assert np.array_equal(s, t), f"leaf {k} diverged"
+
+    def test_mixed_dtypes_survive_streaming(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(3)
+        tree = {
+            "a": rng.randn(8).astype(np.float32),
+            "b": rng.randn(8).astype(np.float16),
+            "c": np.asarray(rng.randn(8), jnp.bfloat16),
+        }
+        out = _reduce(
+            make_manager(quorum=make_quorum(), bucket_cap_bytes=16),
+            tree, streamed=True,
+        )
+        for k in tree:
+            assert np.asarray(out[k]).dtype == np.asarray(tree[k]).dtype
+            np.testing.assert_allclose(
+                np.asarray(out[k], np.float32),
+                np.asarray(tree[k], np.float32) / 2.0,  # AVG of 2
+                rtol=1e-2,
+            )
+
+
+class TestPerBucketCollectives:
+    def test_streamed_issues_one_collective_per_bucket(self):
+        tree = _tree()
+        cap = 2 * 9 * 4
+        plan = bucketing.build_plan(list(tree.values()), cap)
+        pg = CountingPG()
+        m = make_manager(pg=pg, quorum=make_quorum(), bucket_cap_bytes=cap,
+                         stream_buckets=True)
+        _reduce(m, tree, streamed=True)
+        assert pg.allreduce_calls == [1] * len(plan)
+
+    def test_serial_issues_single_plan_collective(self):
+        tree = _tree()
+        cap = 2 * 9 * 4
+        plan = bucketing.build_plan(list(tree.values()), cap)
+        pg = CountingPG()
+        m = make_manager(pg=pg, quorum=make_quorum(), bucket_cap_bytes=cap,
+                         stream_buckets=False)
+        _reduce(m, tree, streamed=False)
+        assert pg.allreduce_calls == [len(plan)]
+
+    def test_env_knob_disables_streaming(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_STREAM_BUCKETS", "0")
+        pg = CountingPG()
+        m = make_manager(pg=pg, quorum=make_quorum(),
+                         bucket_cap_bytes=2 * 9 * 4)
+        assert m._stream_buckets is False
+        # allreduce_streamed degenerates to the serial path + 1-bucket stream
+        m.start_quorum()
+        stream = m.allreduce_streamed(_tree())
+        stream.wait(timeout=30)
+        assert len(pg.allreduce_calls) == 1 and pg.allreduce_calls[0] > 1
+        assert stream.num_buckets == 1
+
+
+class TestStagingNeverBlocksOnWire:
+    def test_all_buckets_dispatch_before_any_wire_completes(self):
+        """Regression: the staging worker must dispatch bucket i+1 without
+        waiting for bucket i's collective to resolve. With every wire gated
+        shut, all k per-bucket dispatches must still arrive."""
+        tree = _tree()
+        cap = 2 * 9 * 4
+        plan = bucketing.build_plan(list(tree.values()), cap)
+        pg = GatedPG()
+        m = make_manager(pg=pg, quorum=make_quorum(), bucket_cap_bytes=cap,
+                         timeout=30.0)
+        m.start_quorum()
+        stream = m.allreduce_streamed(tree)
+        with pg.dispatched:
+            ok = pg.dispatched.wait_for(
+                lambda: len(pg.pending) == len(plan), timeout=10
+            )
+        assert ok, (
+            f"staging dispatched {len(pg.pending)}/{len(plan)} buckets "
+            "while wires were held open — it is blocking on wire completion"
+        )
+        assert not any(stream.ready(i) for i in range(stream.num_buckets))
+        pg.release_all()
+        out = stream.wait(timeout=30)
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(out[k]), tree[k] / 2.0, rtol=1e-6
+            )
+        assert all(stream.ready(i) for i in range(stream.num_buckets))
+
+
+class TestMidStreamFailure:
+    def test_bucket_failure_yields_zeros_and_blocks_commit(self):
+        """A failure on bucket k (not the first!) mid-plan: the aggregate
+        degrades to the full zeros tree (never a partially-applied mix) and
+        the step's should_commit() vote is False."""
+        tree = _tree()
+        cap = 2 * 9 * 4
+        pg = FakeProcessGroupWrapper(ProcessGroupDummy())
+        m = make_manager(pg=pg, quorum=make_quorum(), bucket_cap_bytes=cap)
+        m.start_quorum()
+        pg.report_future_error(RuntimeError("injected wire failure"),
+                               skip_ops=1)
+        stream = m.allreduce_streamed(tree)
+        out = stream.wait(timeout=30)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(out[k]),
+                                          np.zeros_like(tree[k]))
+        assert not stream.ready(1)
+        assert m.errored() is not None  # the wire fault was reported
+        assert m.should_commit() is False
+
+    def test_non_participant_contributes_zeros_streamed(self):
+        """allow_heal=False + behind the cohort: not participating, the
+        streamed path must still run (zero contribution) and commit."""
+        m = make_manager(
+            quorum=make_quorum(
+                heal=True, max_step=1, max_replica_rank=None,
+                recover_src_replica_rank=1,
+            ),
+        )
+        m.start_quorum(allow_heal=False)
+        tree = {f"x{i}": np.ones(9, np.float32) for i in range(6)}
+        out = m.allreduce_streamed(tree, bucket_cap_bytes=2 * 9 * 4).wait(
+            timeout=30
+        )
+        for k in tree:
+            np.testing.assert_allclose(np.asarray(out[k]), 0.0)
+        assert not m.is_participating()
+        assert m.should_commit()
+
+
+class TestGradStream:
+    def test_ready_and_wait_semantics(self):
+        tree = _tree()
+        cap = 2 * 9 * 4
+        plan = bucketing.build_plan(list(tree.values()), cap)
+        m = make_manager(quorum=make_quorum(), bucket_cap_bytes=cap)
+        m.start_quorum()
+        stream = m.allreduce_streamed(tree)
+        assert isinstance(stream, GradStream)
+        assert len(stream) == stream.num_buckets == len(plan)
+        out = stream.wait(timeout=30)
+        assert set(out) == set(tree)
+        assert all(stream.ready(i) for i in range(len(stream)))
+        # the aggregate future and wait() expose the same resolved tree
+        again = stream.get_future().wait(timeout=5)
+        assert again is out
+
+    def test_timings_carry_pipeline_splits(self):
+        m = make_manager(quorum=make_quorum(), bucket_cap_bytes=2 * 9 * 4)
+        m.start_quorum()
+        m.allreduce_streamed(_tree()).wait(timeout=30)
+        deadline = time.monotonic() + 5
+        t = {}
+        while time.monotonic() < deadline:
+            t = m.timings()
+            if "allreduce_buckets" in t:
+                break
+            time.sleep(0.02)
+        assert t.get("allreduce_buckets", 0) > 1
+        for key in ("allreduce_pack_s", "allreduce_wire_s",
+                    "allreduce_unpack_s", "overlap_efficiency"):
+            assert key in t, f"missing pipeline split {key}"
+
+
+class TestJoinFutures:
+    def test_resolves_in_order(self):
+        futs = [Future() for _ in range(3)]
+        joined = join_futures(futs)
+        for i, f in enumerate(reversed(futs)):
+            f.set_result(2 - i)
+        assert joined.wait(timeout=5) == [0, 1, 2]
+
+    def test_fails_fast_on_first_error(self):
+        futs = [Future() for _ in range(3)]
+        joined = join_futures(futs)
+        futs[1].set_exception(RuntimeError("bucket 1 died"))
+        with pytest.raises(RuntimeError, match="bucket 1 died"):
+            joined.wait(timeout=5)
+
+    def test_empty_list_resolves_immediately(self):
+        assert join_futures([]).wait(timeout=1) == []
+
+
+class TestOverlapStatsMath:
+    def test_covered_seconds_merges_overlapping_intervals(self):
+        assert _covered_seconds(0, 10, [(1, 4), (3, 6), (8, 9)]) == 6.0
+        assert _covered_seconds(0, 10, []) == 0.0
+        assert _covered_seconds(5, 5, [(0, 10)]) == 0.0
+        # clipping to the probe window
+        assert _covered_seconds(2, 4, [(0, 10)]) == 2.0
+
+    def test_overlap_efficiency_from_synthetic_marks(self):
+        marks = [
+            {"wire": (1.0, 3.0)},
+            {"pack": (0.0, 2.0), "wire": (2.0, 4.0)},
+        ]
+        stats = _pipeline_overlap_stats(marks)
+        # bucket0's wire [1,3] fully hidden behind bucket1's pack+wire;
+        # bucket1's wire [2,4] only covered on [2,3] by bucket0's wire
+        assert stats["allreduce_wire_s"] == pytest.approx(4.0)
+        assert stats["overlap_efficiency"] == pytest.approx(3.0 / 4.0)
+        assert stats["allreduce_buckets"] == 2.0
+
+    def test_single_bucket_reports_zero_overlap(self):
+        stats = _pipeline_overlap_stats([{"wire": (0.0, 1.0)}])
+        assert stats["overlap_efficiency"] == 0.0
+
+    def test_unreached_stages_are_tolerated(self):
+        # bucket 1 failed before its wire mark landed
+        stats = _pipeline_overlap_stats(
+            [{"pack": (0.0, 1.0), "wire": (1.0, 2.0)}, {"pack": (0.5, 1.5)}]
+        )
+        assert stats["allreduce_buckets"] == 2.0
+        assert stats["allreduce_wire_s"] == pytest.approx(1.0)

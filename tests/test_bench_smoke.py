@@ -3,7 +3,10 @@ plumbing: a tiny virtual-device FT row must produce the per-phase timing
 keys end to end (async quorum overlap, prepare/commit split, chunked
 heal). `--ft-overhead --smoke` is the gate for the steady-state overhead
 harness: the real example trainer under a live Manager must emit
-ft_overhead_pct plus the per-phase cost splits."""
+ft_overhead_pct plus the per-phase cost splits. `--allreduce-pipeline
+--smoke` is the gate for the streaming bucket pipeline: serial vs
+streamed step walls plus the per-bucket stage splits and
+overlap_efficiency must survive end to end."""
 
 import json
 import os
@@ -55,3 +58,17 @@ def test_bench_ft_overhead_smoke_emits_cost_splits():
     assert rec["allreduce_s"] > 0
     assert rec["should_commit_rpc_s"] > 0
     assert rec["bookkeeping_s"] >= 0
+
+
+def test_bench_allreduce_pipeline_smoke_emits_stage_splits():
+    rec = _run_bench("--allreduce-pipeline", "--smoke")
+    assert rec["serial_step_s"] > 0
+    assert rec["streamed_step_s"] > 0
+    assert rec["speedup_pct"] is not None
+    # the per-bucket stage splits prove the streaming pipeline's timing
+    # snapshots (Manager._record_pipeline_timings) measured real buckets
+    assert rec["allreduce_buckets"] > 1
+    assert rec["allreduce_wire_s"] > 0
+    assert rec["allreduce_pack_s"] >= 0
+    assert rec["allreduce_unpack_s"] >= 0
+    assert 0.0 <= rec["overlap_efficiency"] <= 1.0

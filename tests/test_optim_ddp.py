@@ -11,9 +11,20 @@ from torchft_tpu.optim import OptimizerWrapper
 from torchft_tpu.work import DummyWork
 
 
+class _EchoStream:
+    """Stands in for a GradStream: wait() returns the input pytree."""
+
+    def __init__(self, v):
+        self._v = v
+
+    def wait(self):
+        return self._v
+
+
 def mock_manager(commit=True):
     m = MagicMock()
     m.allreduce.side_effect = lambda v, should_quantize=False: DummyWork(v)
+    m.allreduce_streamed.side_effect = lambda v, **kw: _EchoStream(v)
     m.should_commit.return_value = commit
     return m
 
@@ -52,26 +63,36 @@ class TestOptimizerWrapper:
 
 class TestDDP:
     def test_average_gradients_single_collective(self):
+        # the whole tree goes through ONE streamed managed allreduce (the
+        # Manager owns bucketing/overlap; DDP issues a single call)
         m = mock_manager()
         ddp = DistributedDataParallel(m)
         grads = {"a": np.ones(2), "b": np.zeros(3)}
         out = ddp.average_gradients(grads)
-        assert m.allreduce.call_count == 1
+        assert m.allreduce_streamed.call_count == 1
+        assert m.allreduce.call_count == 0
         np.testing.assert_allclose(out["a"], 1.0)
 
     def test_pure_ddp_buckets_same_dtype(self):
-        # same-dtype leaves pack into ONE flat bucket -> one collective
+        # multi-leaf trees route through one streamed call carrying the
+        # wrapper's own bucket cap; the Manager packs/streams per bucket
         m = mock_manager()
         ddp = PureDistributedDataParallel(m)
         grads = {"a": np.ones(2), "b": np.zeros(3)}
         out = ddp.average_gradients(grads)
-        assert m.allreduce.call_count == 1
+        assert m.allreduce_streamed.call_count == 1
+        (_, kwargs) = m.allreduce_streamed.call_args
+        assert kwargs["bucket_cap_bytes"] == ddp._bucket_cap_bytes
         np.testing.assert_allclose(out["a"], 1.0)
         np.testing.assert_allclose(out["b"], 0.0)
 
     def test_pure_ddp_bucket_per_dtype_and_cap(self):
-        # mixed dtypes cannot share a flat buffer -> one bucket each; a
-        # tiny cap splits same-dtype leaves back into per-leaf collectives
+        # mixed dtypes cannot share a flat buffer -> the shared plan keeps
+        # one bucket each; a tiny cap splits same-dtype leaves back into
+        # per-leaf buckets. PureDDP forwards its cap into ONE streamed call
+        # and the Manager's plan carries the per-dtype/cap splits.
+        from torchft_tpu import bucketing
+
         m = mock_manager()
         ddp = PureDistributedDataParallel(m)
         grads = {
@@ -79,15 +100,23 @@ class TestDDP:
             "b": np.zeros(3, np.float64),
         }
         out = ddp.average_gradients(grads)
-        assert m.allreduce.call_count == 2
+        assert m.allreduce_streamed.call_count == 1
         np.testing.assert_allclose(out["b"], 0.0)
+        plan = bucketing.plan_for(
+            [grads["a"], grads["b"]], ddp._bucket_cap_bytes
+        )
+        assert len(plan) == 2  # one bucket per dtype
 
         m2 = mock_manager()
         ddp2 = PureDistributedDataParallel(m2, bucket_cap_bytes=4)
         grads2 = {"a": np.ones(2, np.float32), "b": np.zeros(3, np.float32)}
         out2 = ddp2.average_gradients(grads2)
-        assert m2.allreduce.call_count == 2
+        assert m2.allreduce_streamed.call_count == 1
+        (_, kwargs2) = m2.allreduce_streamed.call_args
+        assert kwargs2["bucket_cap_bytes"] == 4
         np.testing.assert_allclose(out2["a"], 1.0)
+        plan2 = bucketing.plan_for([grads2["a"], grads2["b"]], 4)
+        assert len(plan2) == 2  # cap splits same-dtype leaves
 
 
 class TestStatefulDataIterator:

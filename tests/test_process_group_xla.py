@@ -272,11 +272,15 @@ class TestLocalMode:
             report_error = lambda self, e: None
             _bump_metric = lambda self, name: None
             _commit_pending_configure = lambda self: None
+            _record_timing = lambda self, key, value: None
+            _bucket_cap_bytes = 0
+            _stream_buckets = False
 
             def wrap_future(self, fut, default, **kwargs):
                 return fut
 
             allreduce = Manager.allreduce
+            _allreduce = Manager._allreduce
 
         class _Log:
             def exception(self, *a, **k):
@@ -316,11 +320,15 @@ class TestLocalMode:
             report_error = lambda self, e: None
             _bump_metric = lambda self, name: None
             _commit_pending_configure = lambda self: None
+            _record_timing = lambda self, key, value: None
+            _bucket_cap_bytes = 0
+            _stream_buckets = False
 
             def wrap_future(self, fut, default, **kwargs):
                 return fut
 
             allreduce = Manager.allreduce
+            _allreduce = Manager._allreduce
 
         class _Log:
             def exception(self, *a, **k):

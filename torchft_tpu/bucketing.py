@@ -46,6 +46,7 @@ __all__ = [
     "plan_for",
     "pack",
     "unpack",
+    "unpack_bucket",
     "make_buckets",
     "pack_group",
     "unpack_buckets",
@@ -277,6 +278,23 @@ def unpack(flats: Sequence[Any], plan: BucketPlan) -> List[Any]:
             out[i] = flat[off : off + size].reshape(shape)
     assert all(o is not None for o in out)
     return out  # type: ignore[return-value]
+
+
+def unpack_bucket(flat: Any, plan: BucketPlan, bucket: int) -> List[Tuple[int, Any]]:
+    """Slice ONE reduced bucket into ``(leaf_index, array)`` pairs.
+
+    The streaming pipeline unpacks each bucket as its wire completes instead
+    of waiting for the whole plan; slices are views (numpy) or lazy device
+    slices (jax), exactly as :func:`unpack` produces for that bucket.
+    """
+    import jax
+
+    if not isinstance(flat, jax.Array):
+        flat = np.asarray(flat)
+    return [
+        (i, flat[off : off + size].reshape(shape))
+        for (i, off, size, shape) in plan.metas[bucket]
+    ]
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,7 @@ from torchft_tpu.coordination import (
 )
 from torchft_tpu.futures import future_timeout
 from torchft_tpu.observability import (
+    ALLREDUCE_PIPELINE_PHASE,
     COMMIT_EVENTS,
     TIMING_EVENTS,
     emit_event_async,
@@ -55,7 +56,14 @@ from torchft_tpu.observability import (
     traced,
 )
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
-from torchft_tpu.work import DummyWork, Future, FutureWork, Work
+from torchft_tpu.work import (
+    DummyWork,
+    Future,
+    FutureWork,
+    GradStream,
+    Work,
+    join_futures,
+)
 
 T = TypeVar("T")
 
@@ -73,6 +81,9 @@ QUORUM_RETRIES_ENV = "TORCHFT_QUORUM_RETRIES"
 # bucket cap for the managed allreduce's bucketed path, in MiB; 0 disables
 # bucketing entirely (per-leaf collectives, the pre-bucketing behavior)
 BUCKET_CAP_MB_ENV = "TORCHFT_BUCKET_CAP_MB"
+# per-bucket streaming pipeline for the bucketed allreduce: "0"/"false"
+# forces the serial monolithic path (pack all → one collective → unpack all)
+STREAM_BUCKETS_ENV = "TORCHFT_STREAM_BUCKETS"
 
 
 def _to_seconds(t: "float | timedelta") -> float:
@@ -164,6 +175,7 @@ class Manager:
         heartbeat_interval: "float | timedelta" = 0.1,
         hostname: str = "",
         bucket_cap_bytes: Optional[int] = None,
+        stream_buckets: Optional[bool] = None,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -299,6 +311,21 @@ class Manager:
         # host staging buffers recycle through the pool instead of
         # allocating a gradient-sized buffer per step
         self._buffer_pool = bucketing.BufferPool()
+        # streaming bucket pipeline: env var > constructor > default ON.
+        # Off means the pre-pipeline behavior: one monolithic collective
+        # per plan, unpacked only after the LAST bucket's wire completes.
+        env_stream = os.environ.get(STREAM_BUCKETS_ENV)
+        if env_stream is not None:
+            self._stream_buckets = env_stream.strip().lower() not in (
+                "0",
+                "false",
+                "no",
+                "off",
+            )
+        elif stream_buckets is not None:
+            self._stream_buckets = bool(stream_buckets)
+        else:
+            self._stream_buckets = True
 
         self._step = 0
         self._quorum_id = -1
@@ -344,6 +371,13 @@ class Manager:
         # dispatch off the train loop, issue order preserved across replicas
         self._staging_executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="torchft_stage"
+        )
+        # pipeline stage 3: per-bucket unpack + device landing runs here so
+        # it neither blocks the PG's dispatch thread (which would serialize
+        # the NEXT bucket's wire behind this bucket's unpack) nor waits for
+        # the last bucket's wire like the monolithic path did
+        self._unpack_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="torchft_unpack"
         )
         # (executor future, staged future) pairs still in flight: shutdown
         # must fail the staged futures of cancelled tasks or their waiters
@@ -717,7 +751,6 @@ class Manager:
             self._log_timing_snapshot("configure_commit")
 
     # ------------------------------------------------------------ allreduce
-    @traced("torchft::manager::allreduce")
     def allreduce(
         self,
         values: Any,
@@ -730,6 +763,48 @@ class Manager:
         device placement matching the inputs). On error, the future resolves
         to a zeros pytree and the error is tracked for ``should_commit``
         (reference: manager.py:410-493).
+        """
+        work, _stream = self._allreduce(values, should_quantize, reduce_op)
+        return work
+
+    def allreduce_streamed(
+        self,
+        values: Any,
+        reduce_op: ReduceOp = ReduceOp.AVG,
+        bucket_cap_bytes: Optional[int] = None,
+    ) -> GradStream:
+        """Streaming variant: per-bucket completion through a GradStream.
+
+        Same numerics, error swallowing (zeros + ``should_commit`` False),
+        and ordering contract as :meth:`allreduce`, but the returned handle
+        exposes ``ready(i)`` per bucket so a gradient-accumulation loop can
+        watch buckets land while later microbatches still compute, and
+        ``wait()`` returns the reduced pytree directly. When the tree cannot
+        stream (single leaf, bucketing or streaming disabled, quantized),
+        the handle degenerates to one bucket covering the whole op.
+        ``bucket_cap_bytes`` overrides the manager's cap for this call
+        (``PureDistributedDataParallel`` routes its own cap through here).
+        """
+        work, stream = self._allreduce(
+            values, False, reduce_op, bucket_cap_bytes=bucket_cap_bytes
+        )
+        if stream is None:
+            fut = work.get_future()
+            stream = GradStream([fut], fut)
+        return stream
+
+    @traced("torchft::manager::allreduce")
+    def _allreduce(
+        self,
+        values: Any,
+        should_quantize: bool = False,
+        reduce_op: ReduceOp = ReduceOp.AVG,
+        bucket_cap_bytes: Optional[int] = None,
+    ) -> "tuple[Work, Optional[GradStream]]":
+        """Shared engine behind allreduce / allreduce_streamed.
+
+        Returns ``(work, stream)``; ``stream`` is a GradStream when the op
+        took the per-bucket streaming pipeline, else None (serial path).
         """
         import jax
 
@@ -744,64 +819,72 @@ class Manager:
         # pre-bucketed: collectives.py already concatenates into one flat
         # wire buffer, and packing first would shift the fp8 rowwise-scale
         # boundaries (changing numerics).
+        cap = (
+            self._bucket_cap_bytes
+            if bucket_cap_bytes is None
+            else int(bucket_cap_bytes)
+        )
         plan: Optional[bucketing.BucketPlan] = None
-        if not should_quantize and len(leaves) > 1 and self._bucket_cap_bytes > 0:
+        if not should_quantize and len(leaves) > 1 and cap > 0:
             try:
-                plan = bucketing.plan_for(
-                    leaves, self._bucket_cap_bytes, treedef=treedef
-                )
+                plan = bucketing.plan_for(leaves, cap, treedef=treedef)
             except Exception:  # noqa: BLE001 — exotic leaves fall back per-leaf
                 plan = None
 
-        def rebuild(host_leaves: List[np.ndarray]) -> Any:
+        # Staleness check at RESOLVE time: if the input leaf's sharding
+        # references a device client that is no longer the live backend
+        # (ProcessGroupXLA tore down + rejoined its per-quorum
+        # jax.distributed world between the caller computing `values`
+        # and this resolve), a device_put onto it can SUCCEED and
+        # produce an array the next jitted computation rejects as
+        # "incompatible devices". Land such leaves on the live backend
+        # instead — _sync_device_world re-lands the user's own state
+        # the same way at should_commit. LAZY on purpose: jax.devices()
+        # initializes the backend, and a pure-host tree must never
+        # trigger that (a wedged accelerator plugin hangs init — the
+        # host plane has to keep working through exactly that state).
+        live_client = [False]
+
+        def _is_live(sharding) -> bool:
+            if live_client[0] is False:
+                try:
+                    live_client[0] = getattr(
+                        jax.devices()[0], "client", None
+                    )
+                except Exception:  # noqa: BLE001
+                    live_client[0] = None
+            if live_client[0] is None:
+                return True
+            try:
+                dev = next(iter(sharding.device_set))
+                return getattr(dev, "client", None) is live_client[0]
+            except Exception:  # noqa: BLE001
+                return False
+
+        def place_leaf(orig: Any, host: Any) -> Any:
+            # restore one reduced slice to its original leaf's placement —
+            # shared by the monolithic rebuild and the per-bucket pipeline
+            # so both paths land leaves through identical expressions
             import jax.numpy as jnp
 
-            # Staleness check at RESOLVE time: if the input leaf's sharding
-            # references a device client that is no longer the live backend
-            # (ProcessGroupXLA tore down + rejoined its per-quorum
-            # jax.distributed world between the caller computing `values`
-            # and this resolve), a device_put onto it can SUCCEED and
-            # produce an array the next jitted computation rejects as
-            # "incompatible devices". Land such leaves on the live backend
-            # instead — _sync_device_world re-lands the user's own state
-            # the same way at should_commit. LAZY on purpose: jax.devices()
-            # initializes the backend, and a pure-host tree must never
-            # trigger that (a wedged accelerator plugin hangs init — the
-            # host plane has to keep working through exactly that state).
-            live_client = [False]
+            if isinstance(orig, jax.Array):
+                if _is_live(orig.sharding):
+                    return jax.device_put(host, orig.sharding)
+                return jnp.asarray(np.asarray(host))
+            return np.asarray(host)
 
-            def _is_live(sharding) -> bool:
-                if live_client[0] is False:
-                    try:
-                        live_client[0] = getattr(
-                            jax.devices()[0], "client", None
-                        )
-                    except Exception:  # noqa: BLE001
-                        live_client[0] = None
-                if live_client[0] is None:
-                    return True
-                try:
-                    dev = next(iter(sharding.device_set))
-                    return getattr(dev, "client", None) is live_client[0]
-                except Exception:  # noqa: BLE001
-                    return False
-
-            out = []
-            for orig, host in zip(leaves, host_leaves):
-                if isinstance(orig, jax.Array):
-                    if _is_live(orig.sharding):
-                        out.append(jax.device_put(host, orig.sharding))
-                    else:
-                        out.append(jnp.asarray(np.asarray(host)))
-                else:
-                    out.append(np.asarray(host))
+        def rebuild(host_leaves: List[np.ndarray]) -> Any:
+            out = [
+                place_leaf(orig, host)
+                for orig, host in zip(leaves, host_leaves)
+            ]
             return jax.tree_util.tree_unflatten(treedef, out)
 
         def zeros() -> Any:
             return rebuild([np.zeros(np.shape(l), _np_dtype(l)) for l in leaves])
 
         if self.errored():
-            return DummyWork(zeros())
+            return DummyWork(zeros()), None
 
         self.wait_quorum()
         # a reconfigure that landed during the forward pass commits its
@@ -809,7 +892,7 @@ class Manager:
         # is the "next safe point" for steps that skip should_commit
         self._commit_pending_configure()
         if self.errored():
-            return DummyWork(zeros())
+            return DummyWork(zeros()), None
         num_participants = self.num_participants()
 
         # Device-native PGs (ProcessGroupXLA) take jax.Arrays straight
@@ -849,7 +932,283 @@ class Manager:
                 reduced = bucketing.unpack(reduced, plan)
             return rebuild(reduced)
 
+        def _time_allreduce(_f: Future) -> None:
+            # submission → resolve wall clock of the most recent
+            # collective, for the steady-state budget split
+            # (ft_overhead harness; see timings())
+            self._record_timing(
+                "allreduce_s", time.perf_counter() - t_allreduce0
+            )
+
         try:
+            if plan is not None and self._stream_buckets:
+                # ---------------- streaming bucket pipeline ----------------
+                # One PG collective PER BUCKET instead of one for the whole
+                # plan, three stages per bucket: pack (D2H / device concat),
+                # wire (the PG's dispatch thread or XLA), unpack (divide +
+                # slice + land on device, on the dedicated unpack worker).
+                # Bucket i+1 packs while bucket i rides the wire and bucket
+                # i−1 unpacks — no stage ever waits for the LAST bucket's
+                # wire, which is exactly what the monolithic path did.
+                # Numerics are bit-identical to the serial path: per-bucket
+                # collectives reduce each flat independently just like one
+                # call carrying the list, and divide/slice/land use the same
+                # expressions (normalize / unpack / place_leaf).
+                import jax.numpy as jnp
+
+                n_buckets = len(plan)
+                # per-bucket (start, end) wall-clock marks per stage, for
+                # pack_s/wire_s/unpack_s + overlap_efficiency in timings()
+                marks: List[Dict[str, Any]] = [{} for _ in range(n_buckets)]
+                bucket_futs: List[Future] = [Future() for _ in range(n_buckets)]
+                # aggregate: every bucket landed -> reassembled pytree.
+                # final_fut is fed from the join but owned here so the
+                # staging watchdog / shutdown sweep can fail it directly.
+                final_fut: Future = Future()
+
+                def _assemble(f: Future) -> Any:
+                    placed: Dict[int, Any] = {}
+                    for pairs in f.value():
+                        for idx, v in pairs:
+                            placed[idx] = v
+                    return jax.tree_util.tree_unflatten(
+                        treedef, [placed[i] for i in range(len(leaves))]
+                    )
+
+                def _feed_final(f: Future) -> None:
+                    try:
+                        v = f.value()
+                    except Exception as e:  # noqa: BLE001
+                        try:
+                            final_fut.set_exception(e)
+                        except RuntimeError:
+                            pass
+                        return
+                    try:
+                        final_fut.set_result(v)
+                    except RuntimeError:
+                        pass
+
+                join_futures(bucket_futs).then(_assemble).add_done_callback(
+                    _feed_final
+                )
+
+                participating = self.is_participating()
+                pool = self._buffer_pool
+
+                def _land_bucket(i: int, flat: Any, pooled_buf: Any) -> None:
+                    # stage 3, off the PG dispatch thread: AVG divide +
+                    # slice + device landing for ONE bucket. A failure here
+                    # fails the aggregate via the join; earlier buckets'
+                    # landed slices are only reachable through the aggregate
+                    # tree, so a mid-stream error can never leak a
+                    # partially-applied reduction.
+                    try:
+                        t0u = time.perf_counter()
+                        if reduce_op == ReduceOp.AVG and num_participants > 0:
+                            flat = (flat / num_participants).astype(
+                                _np_dtype(flat)
+                            )
+                        pairs = [
+                            (idx, place_leaf(leaves[idx], val))
+                            for idx, val in bucketing.unpack_bucket(
+                                flat, plan, i
+                            )
+                        ]
+                        marks[i]["unpack"] = (t0u, time.perf_counter())
+                        if pooled_buf is not None and not any(
+                            isinstance(v, np.ndarray)
+                            and np.shares_memory(v, pooled_buf)
+                            for _idx, v in pairs
+                        ):
+                            # recycle this bucket's staging buffer the
+                            # moment it lands (success only; never when the
+                            # PG passed it through as its own result)
+                            pool.release(pooled_buf)
+                        bucket_futs[i].set_result(pairs)
+                    except Exception as e:  # noqa: BLE001
+                        try:
+                            bucket_futs[i].set_exception(e)
+                        except RuntimeError:
+                            pass
+
+                if device_native:
+                    # device plane: issue per-bucket collectives straight
+                    # from the caller thread — ProcessGroupXLA rendezvouses
+                    # ops by (kind, seq), and per-bucket ops let XLA overlap
+                    # ICI transfers with adjacent compute
+                    t0p = time.perf_counter()
+                    if participating:
+                        up = [
+                            l if isinstance(l, jax.Array) else jnp.asarray(l)
+                            for l in leaves
+                        ]
+                        dev_flats, _ = bucketing.pack(up, plan)
+                    else:
+                        dev_flats = [
+                            jnp.zeros(size, dtype)
+                            for size, dtype in zip(plan.sizes, plan.dtypes)
+                        ]
+                    marks[0]["pack"] = (t0p, time.perf_counter())
+                    for i in range(n_buckets):
+                        t0w = time.perf_counter()
+                        w = self._pg.allreduce([dev_flats[i]], pg_reduce_op)
+
+                        def _wire_done(
+                            f: Future, i: int = i, t0w: float = t0w
+                        ) -> None:
+                            marks[i]["wire"] = (t0w, time.perf_counter())
+                            try:
+                                flat = f.value()[0]
+                            except Exception as e:  # noqa: BLE001
+                                try:
+                                    bucket_futs[i].set_exception(e)
+                                except RuntimeError:
+                                    pass
+                                return
+                            _land_bucket(i, flat, None)
+
+                        w.get_future().add_done_callback(_wire_done)
+                else:
+                    # host plane: capture on the caller thread (donation
+                    # safety, same as the serial path), then ONE staging
+                    # task walks the buckets — D2H bucket i, non-blocking
+                    # dispatch, straight on to bucket i+1 while the PG's
+                    # dispatch thread runs the wire. A single task keeps
+                    # per-plan dispatch atomic across concurrent callers,
+                    # preserving cross-replica arrival order (the SPMD
+                    # contract of the host exchange).
+                    if participating:
+                        capture, pooled = bucketing.pack(
+                            leaves, plan, pool=pool
+                        )
+                    else:
+                        capture, pooled = None, []
+                    pooled_ids = {id(b) for b in pooled}
+                    stage_timeout = self._timeout
+
+                    def _stage_deadline() -> None:
+                        try:
+                            final_fut.set_exception(
+                                TimeoutError("allreduce staging timed out")
+                            )
+                        except RuntimeError:
+                            pass
+
+                    def stage() -> None:
+                        try:
+                            from torchft_tpu.futures import arm_deadline
+
+                            cancel = arm_deadline(
+                                _stage_deadline, stage_timeout
+                            )
+                            final_fut.add_done_callback(lambda _f: cancel())
+                            for i in range(n_buckets):
+                                t0b = time.perf_counter()
+                                if capture is None:
+                                    host_flat = np.zeros(
+                                        (plan.sizes[i],), plan.dtypes[i]
+                                    )
+                                    pooled_buf = None
+                                else:
+                                    host_flat = np.asarray(capture[i])
+                                    pooled_buf = (
+                                        capture[i]
+                                        if id(capture[i]) in pooled_ids
+                                        else None
+                                    )
+                                w = self._pg.allreduce(
+                                    [host_flat], pg_reduce_op
+                                )
+                                t1b = time.perf_counter()
+                                marks[i]["pack"] = (t0b, t1b)
+
+                                def _wire_done(
+                                    f: Future,
+                                    i: int = i,
+                                    t0w: float = t1b,
+                                    pooled_buf: Any = pooled_buf,
+                                ) -> None:
+                                    # runs on the PG dispatch thread — keep
+                                    # it tiny: record, then hand unpack to
+                                    # the unpack worker so the NEXT bucket's
+                                    # wire starts immediately
+                                    marks[i]["wire"] = (
+                                        t0w,
+                                        time.perf_counter(),
+                                    )
+                                    try:
+                                        flat = f.value()[0]
+                                    except Exception as e:  # noqa: BLE001
+                                        try:
+                                            bucket_futs[i].set_exception(e)
+                                        except RuntimeError:
+                                            pass
+                                        return
+                                    try:
+                                        self._unpack_executor.submit(
+                                            _land_bucket, i, flat, pooled_buf
+                                        )
+                                    except RuntimeError as e:  # shutdown
+                                        try:
+                                            bucket_futs[i].set_exception(e)
+                                        except RuntimeError:
+                                            pass
+
+                                w.get_future().add_done_callback(_wire_done)
+                        except Exception as e:  # noqa: BLE001
+                            for bf in bucket_futs:
+                                try:
+                                    bf.set_exception(e)
+                                except RuntimeError:
+                                    pass
+
+                    from torchft_tpu.futures import arm_deadline as _arm
+
+                    # submit + register atomically vs the shutdown sweep,
+                    # with the same depth-aware submission backstop as the
+                    # serial path (a wedged op ahead of us means stage()
+                    # never runs and never arms the tight deadline)
+                    with self._staged_lock:
+                        if self._staging_down:
+                            raise RuntimeError("manager is shut down")
+                        depth = len(self._staged_pending)
+                        backstop_cancel = _arm(
+                            _stage_deadline, (depth + 2) * stage_timeout
+                        )
+                        final_fut.add_done_callback(
+                            lambda _f: backstop_cancel()
+                        )
+                        exec_fut = self._staging_executor.submit(stage)
+                        pair = (exec_fut, final_fut)
+                        self._staged_pending.append(pair)
+
+                    def _unpin(_f: Future) -> None:
+                        with self._staged_lock:
+                            try:
+                                self._staged_pending.remove(pair)
+                            except ValueError:
+                                pass
+
+                    final_fut.add_done_callback(_unpin)
+
+                wrapped = self.wrap_future(
+                    final_fut, zeros, arm_timeout=device_native
+                )
+                wrapped.add_done_callback(_time_allreduce)
+
+                def _finalize_pipeline(_f: Future) -> None:
+                    try:
+                        self._record_pipeline_timings(marks)
+                    except Exception:  # noqa: BLE001
+                        self._logger.exception(
+                            "failed to record pipeline timings"
+                        )
+
+                wrapped.add_done_callback(_finalize_pipeline)
+                stream = GradStream(bucket_futs, wrapped)
+                return FutureWork(wrapped), stream
+
             if device_native:
                 import jax.numpy as jnp
 
@@ -1081,21 +1440,12 @@ class Manager:
             # a submission timer would charge queue time behind an
             # in-flight quantized sync against this op.
             fut = self.wrap_future(fut, zeros, arm_timeout=device_native)
-
-            def _time_allreduce(_f: Future) -> None:
-                # submission → resolve wall clock of the most recent
-                # collective, for the steady-state budget split
-                # (ft_overhead harness; see timings())
-                self._record_timing(
-                    "allreduce_s", time.perf_counter() - t_allreduce0
-                )
-
             fut.add_done_callback(_time_allreduce)
-            return FutureWork(fut)
+            return FutureWork(fut), None
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"got exception in allreduce -- skipping remaining: {e}")
             self.report_error(e)
-            return DummyWork(zeros())
+            return DummyWork(zeros()), None
 
     # ------------------------------------------------------------ metrics
     def _bump_metric(self, name: str) -> None:
@@ -1116,6 +1466,20 @@ class Manager:
         with self._metrics_lock:
             self._timings[name] = value
 
+    def _record_pipeline_timings(self, marks: List[Dict[str, Any]]) -> None:
+        """Fold one streamed allreduce's per-bucket stage marks into
+        timings(): summed ``allreduce_pack_s`` / ``allreduce_wire_s`` /
+        ``allreduce_unpack_s``, the bucket count, and
+        ``overlap_efficiency`` — the fraction of total wire time that ran
+        concurrently with OTHER buckets' pipeline stages (a lower bound on
+        the real win: overlap with the caller's own compute, e.g. the next
+        microbatch's grad_fn, is invisible from here). Emitted to the
+        ``torchft_timings`` stream through the bounded async drain."""
+        stats = _pipeline_overlap_stats(marks)
+        with self._metrics_lock:
+            self._timings.update(stats)
+        self._log_timing_snapshot(ALLREDUCE_PIPELINE_PHASE)
+
     def timings(self) -> Dict[str, float]:
         """Per-phase wall-clock of the most recent quorum cycle:
         ``quorum_overlap_s`` (control-plane time on the quorum thread —
@@ -1124,7 +1488,11 @@ class Manager:
         reconfigure; commit is the only part that serializes with the
         trainer), and ``heal_send_s`` / ``heal_recv_s`` plus
         ``heal_chunks`` / ``heal_mb_per_s`` when the checkpoint transport
-        reports chunk-stream stats. Keys appear once the phase has run."""
+        reports chunk-stream stats. Streamed allreduces add
+        ``allreduce_pack_s`` / ``allreduce_wire_s`` / ``allreduce_unpack_s``
+        / ``allreduce_buckets`` / ``overlap_efficiency`` (see
+        :meth:`_record_pipeline_timings`). Keys appear once the phase has
+        run."""
         with self._metrics_lock:
             return dict(self._timings)
 
@@ -1444,6 +1812,10 @@ class Manager:
         with self._staged_lock:
             self._staging_down = True
         self._staging_executor.shutdown(wait=wait, cancel_futures=not wait)
+        # pipeline unpack worker: cancelled bucket unpacks leave their
+        # bucket futures unresolved — the aggregate is bounded by the stage
+        # watchdog / sweep below, so no waiter stalls past the timeout
+        self._unpack_executor.shutdown(wait=wait, cancel_futures=not wait)
         with self._staged_lock:
             pending, self._staged_pending = self._staged_pending, []
         for exec_fut, staged_fut in pending:
@@ -1473,3 +1845,66 @@ class Manager:
 
 def _np_dtype(x: Any) -> Any:
     return np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype
+
+
+def _covered_seconds(
+    start: float, end: float, intervals: List[Any]
+) -> float:
+    """Length of ``[start, end]`` covered by the union of ``intervals``."""
+    if end <= start:
+        return 0.0
+    clipped = sorted(
+        (max(start, a), min(end, b))
+        for a, b in intervals
+        if b > start and a < end
+    )
+    total = 0.0
+    cur_s: Optional[float] = None
+    cur_e = 0.0
+    for a, b in clipped:
+        if cur_s is None:
+            cur_s, cur_e = a, b
+        elif a <= cur_e:
+            cur_e = max(cur_e, b)
+        else:
+            total += cur_e - cur_s
+            cur_s, cur_e = a, b
+    if cur_s is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def _pipeline_overlap_stats(marks: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Summarize one streamed allreduce's per-bucket stage marks.
+
+    ``marks[i]`` maps stage name (``pack`` / ``wire`` / ``unpack``) to a
+    ``(start, end)`` perf_counter interval; stages a bucket never reached
+    (mid-stream failure, timeout) are simply absent. ``overlap_efficiency``
+    is Σᵢ |wireᵢ ∩ ∪ⱼ≠ᵢ(packⱼ ∪ wireⱼ ∪ unpackⱼ)| / Σᵢ |wireᵢ| — the
+    fraction of wire time hidden behind other buckets' pipeline stages
+    (a lower bound: overlap with caller compute is not observable here).
+    A single-bucket plan has nothing to hide behind and reports 0.0."""
+    pack_s = sum(e - s for m in marks if "pack" in m for s, e in [m["pack"]])
+    wire_s = sum(e - s for m in marks if "wire" in m for s, e in [m["wire"]])
+    unpack_s = sum(
+        e - s for m in marks if "unpack" in m for s, e in [m["unpack"]]
+    )
+    hidden = 0.0
+    for i, m in enumerate(marks):
+        if "wire" not in m:
+            continue
+        s, e = m["wire"]
+        others = [
+            iv
+            for j, mj in enumerate(marks)
+            if j != i
+            for iv in mj.values()
+        ]
+        hidden += _covered_seconds(s, e, others)
+    return {
+        "allreduce_pack_s": pack_s,
+        "allreduce_wire_s": wire_s,
+        "allreduce_unpack_s": unpack_s,
+        "allreduce_buckets": float(len(marks)),
+        "overlap_efficiency": (hidden / wire_s) if wire_s > 0 else 0.0,
+    }

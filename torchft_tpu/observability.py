@@ -8,7 +8,13 @@ Three structured event streams mirror the reference's loggers:
 - ``torchft_commits`` — one record per ``should_commit`` decision;
 - ``torchft_errors`` — one record per reported error / PG abort;
 - ``torchft_timings`` — per-phase wall-clock snapshots of a reconfigure
-  cycle (quorum overlap, configure prepare/commit, heal transfer).
+  cycle (quorum overlap, configure prepare/commit, heal transfer) and of
+  the data plane: each streamed allreduce emits a
+  ``phase="allreduce_pipeline"`` snapshot carrying the per-bucket stage
+  splits (``allreduce_pack_s`` / ``allreduce_wire_s`` /
+  ``allreduce_unpack_s``, ``allreduce_buckets``) plus
+  ``overlap_efficiency`` — the fraction of wire time hidden behind other
+  buckets' pipeline stages.
 
 Records are JSON-serialised into the standard ``logging`` stream, and — when
 ``TORCHFT_USE_OTEL=1`` and the ``opentelemetry`` packages are importable —
@@ -39,8 +45,11 @@ QUORUM_EVENTS = "torchft_quorums"
 COMMIT_EVENTS = "torchft_commits"
 ERROR_EVENTS = "torchft_errors"
 # per-phase wall-clock snapshots of a quorum/reconfigure cycle
-# (quorum_overlap_s, configure_prepare_s, configure_commit_s, heal_*)
+# (quorum_overlap_s, configure_prepare_s, configure_commit_s, heal_*) and
+# of the streamed allreduce pipeline (phase=ALLREDUCE_PIPELINE_PHASE:
+# allreduce_pack_s/wire_s/unpack_s, allreduce_buckets, overlap_efficiency)
 TIMING_EVENTS = "torchft_timings"
+ALLREDUCE_PIPELINE_PHASE = "allreduce_pipeline"
 
 _otel_providers: Dict[str, Any] = {}
 

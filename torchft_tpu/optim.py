@@ -14,6 +14,7 @@ from typing import Any, Tuple
 import optax
 
 from torchft_tpu.manager import Manager
+from torchft_tpu.work import GradStream
 
 __all__ = ["OptimizerWrapper"]
 
@@ -47,6 +48,18 @@ class OptimizerWrapper:
 
     # alias for API parity with the reference
     zero_grad = start_step
+
+    def allreduce_gradients(self, grads: Any) -> GradStream:
+        """Kick off a streamed managed allreduce for one microbatch's grads.
+
+        Returns immediately with a :class:`GradStream`; buckets reduce and
+        land while the caller computes the next microbatch. A
+        gradient-accumulation loop issues one stream per microbatch and
+        averages the ``wait()`` results after the last one — allreduce is
+        linear, so mean-of-streamed-means equals reducing the accumulated
+        mean, and every stream's wire rides under the next microbatch's
+        grad_fn (see examples/train_ddp.py ``--grad-accum``)."""
+        return self.manager.allreduce_streamed(grads)
 
     def commit(self) -> bool:
         """The commit vote alone (``manager.should_commit()``).

@@ -342,6 +342,14 @@ class _Comm:
         # one socket
         self._send_locks: Dict[int, threading.Lock] = {}
         self._p2p_queues: Dict[int, "queue.Queue"] = {}
+        # persistent collective-writer worker (lazily started): ring hops and
+        # full-mesh exchanges need a concurrent writer so symmetric
+        # send/send never deadlocks on full TCP buffers, but spawning a
+        # thread PER HOP charges every collective ~2 thread creations —
+        # ruinous for the per-bucket streaming pipeline where a 16-bucket
+        # plan is 16 ops instead of one. One long-lived worker fed by a
+        # queue keeps the same concurrency at a queue-handoff price.
+        self._coll_q: Optional["queue.Queue"] = None
         # traffic accounting (benchmarks/transport_bench.py asserts the ring
         # path's world-size-independent per-rank bytes from these)
         self.bytes_sent = 0
@@ -441,22 +449,50 @@ class _Comm:
             got += n
         self.bytes_recv += length + _HDR.size
 
-    def exchange(self, payloads: Dict[int, Any]) -> Dict[int, Any]:
-        """Send payloads[r] to each rank r and receive one object from every
-        peer. Deadlock-free: a writer thread streams our sends while the
-        caller thread drains receives."""
-        err: List[BaseException] = []
-
-        def _writer() -> None:
+    def _coll_writer_loop(self, q: "queue.Queue") -> None:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            job, done, err = item
             try:
-                for peer in sorted(payloads):
-                    if peer != self.rank:
-                        self.send_to(peer, payloads[peer])
+                job()
             except BaseException as e:  # noqa: BLE001
                 err.append(e)
+            finally:
+                done.set()
 
-        t = threading.Thread(target=_writer, daemon=True)
-        t.start()
+    def submit_write(self, job: Callable[[], None]):
+        """Run ``job`` on the persistent collective-writer thread; returns
+        ``(done_event, err_list)``. Sentinel-safe vs abort: the aborted
+        check and the enqueue share ``_lock`` with ``abort``'s sentinel
+        post, so a job can never land behind the shutdown sentinel and
+        leave its waiter blocked forever."""
+        done = threading.Event()
+        err: List[BaseException] = []
+        with self._lock:
+            if self.aborted:
+                raise RuntimeError("communicator aborted")
+            if self._coll_q is None:
+                self._coll_q = queue.Queue()
+                threading.Thread(
+                    target=self._coll_writer_loop, args=(self._coll_q,),
+                    daemon=True, name=f"pg_host_collwr_r{self.rank}",
+                ).start()
+            self._coll_q.put((job, done, err))
+        return done, err
+
+    def exchange(self, payloads: Dict[int, Any]) -> Dict[int, Any]:
+        """Send payloads[r] to each rank r and receive one object from every
+        peer. Deadlock-free: the collective-writer worker streams our sends
+        while the caller thread drains receives."""
+
+        def _writes() -> None:
+            for peer in sorted(payloads):
+                if peer != self.rank:
+                    self.send_to(peer, payloads[peer])
+
+        done, err = self.submit_write(_writes)
         out: Dict[int, Any] = {}
         if self.rank in payloads:
             out[self.rank] = payloads[self.rank]
@@ -464,7 +500,7 @@ class _Comm:
             if peer == self.rank:
                 continue
             out[peer] = self.recv_from(peer)
-        t.join()
+        done.wait()
         if err:
             raise err[0]
         return out
@@ -516,6 +552,8 @@ class _Comm:
             self.aborted = True
             for q in self._p2p_queues.values():
                 q.put(None)
+            if self._coll_q is not None:
+                self._coll_q.put(None)
             for s in self.peers.values():
                 try:
                     s.shutdown(socket.SHUT_RDWR)
@@ -539,21 +577,13 @@ _RING_MIN_BYTES = 64 * 1024
 def _ring_step(comm: "_Comm", right: int, left: int,
                send_buf: np.ndarray, recv_buf: np.ndarray) -> None:
     """One ring hop: stream our segment to the right neighbour while
-    draining the left neighbour's into ``recv_buf``. The writer runs on a
-    side thread because both sides send first — with synchronous sockets
-    and multi-MB segments that would deadlock on full TCP buffers."""
-    err: List[BaseException] = []
-
-    def _writer() -> None:
-        try:
-            comm.send_raw(right, send_buf)
-        except BaseException as e:  # noqa: BLE001
-            err.append(e)
-
-    t = threading.Thread(target=_writer, daemon=True)
-    t.start()
+    draining the left neighbour's into ``recv_buf``. The write rides the
+    comm's persistent collective-writer worker because both sides send
+    first — with synchronous sockets and multi-MB segments that would
+    deadlock on full TCP buffers."""
+    done, err = comm.submit_write(lambda: comm.send_raw(right, send_buf))
     comm.recv_raw_into(left, recv_buf)
-    t.join()
+    done.wait()
     if err:
         raise err[0]
 
@@ -1571,6 +1601,7 @@ class FakeProcessGroupWrapper(ProcessGroup):
         super().__init__()
         self._pg = pg
         self._next_error: Optional[Exception] = None
+        self._next_error_skip = 0
         self._next_configure_error: Optional[Exception] = None
         # test hook: called at the START of prepare_configure (on the
         # quorum thread) — EventInjector uses it to stall the prepare
@@ -1581,8 +1612,13 @@ class FakeProcessGroupWrapper(ProcessGroup):
     def device_native(self) -> bool:
         return getattr(self._pg, "device_native", False)
 
-    def report_future_error(self, e: Exception) -> None:
+    def report_future_error(self, e: Exception, skip_ops: int = 0) -> None:
+        """Fail one upcoming op's future with ``e``. ``skip_ops=k`` lets the
+        next k ops through untouched and fails the (k+1)-th — with the
+        per-bucket streaming pipeline, that targets bucket k of a plan
+        mid-stream instead of only ever the first collective."""
         self._next_error = e
+        self._next_error_skip = int(skip_ops)
 
     def report_configure_error(self, e: Exception) -> None:
         self._next_configure_error = e
@@ -1631,6 +1667,9 @@ class FakeProcessGroupWrapper(ProcessGroup):
 
     def _maybe_fail(self, work: Work) -> Work:
         if self._next_error is not None:
+            if self._next_error_skip > 0:
+                self._next_error_skip -= 1
+                return work
             e, self._next_error = self._next_error, None
             fut: Future[Any] = Future()
 

@@ -17,7 +17,14 @@ from typing import Any, Callable, Generic, List, Optional, TypeVar
 T = TypeVar("T")
 S = TypeVar("S")
 
-__all__ = ["Future", "Work", "DummyWork", "FutureWork"]
+__all__ = [
+    "Future",
+    "Work",
+    "DummyWork",
+    "FutureWork",
+    "GradStream",
+    "join_futures",
+]
 
 
 class Future(Generic[T]):
@@ -177,3 +184,84 @@ class FutureWork(Work):
 
     def get_future(self) -> Future[Any]:
         return self._future
+
+
+def join_futures(futures: List[Future[Any]]) -> Future[List[Any]]:
+    """Join futures into one that resolves to ``[f.value() for f in futures]``.
+
+    Fails fast: the first input exception resolves the joined future with that
+    exception (later results are dropped). An empty list resolves immediately.
+    """
+    out: Future[List[Any]] = Future()
+    if not futures:
+        out.set_result([])
+        return out
+
+    remaining = [len(futures)]
+    lock = threading.Lock()
+
+    def _on_done(fut: Future[Any]) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            try:
+                out.set_exception(exc)
+            except RuntimeError:
+                pass  # a sibling already failed the join
+            return
+        with lock:
+            remaining[0] -= 1
+            last = remaining[0] == 0
+        if last:
+            try:
+                out.set_result([f.value() for f in futures])
+            except RuntimeError:
+                pass
+
+    for f in futures:
+        f.add_done_callback(_on_done)
+    return out
+
+
+class GradStream(Work):
+    """Handle for a per-bucket streaming allreduce (Manager.allreduce_streamed).
+
+    Exposes per-bucket completion (``ready(i)``) so gradient-accumulation
+    loops can observe buckets landing while later microbatches still compute,
+    plus an aggregate that joins every bucket.
+
+    Deviation from the ``Work.wait -> bool`` convention: ``wait()`` returns
+    the reduced pytree (zeros on swallowed communicator failure, mirroring
+    ``manager.allreduce(...).get_future().wait()``) because that is the value
+    callers of the streamed API want. ``get_future()`` returns the same
+    aggregate future for Work-style chaining.
+    """
+
+    def __init__(
+        self, bucket_futures: List[Future[Any]], aggregate: Future[Any]
+    ) -> None:
+        self._bucket_futures = list(bucket_futures)
+        self._aggregate = aggregate
+
+    def __len__(self) -> int:
+        return len(self._bucket_futures)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._bucket_futures)
+
+    def ready(self, i: int) -> bool:
+        """True once bucket ``i`` has reduced, unpacked, and landed on device.
+
+        A bucket that failed (or never completes after a mid-stream error)
+        reports ``False``; per-bucket results are only exposed through the
+        aggregate so a failed stream cannot leak partially-applied buckets.
+        """
+        fut = self._bucket_futures[i]
+        return fut.done() and fut.exception() is None
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        """Block until every bucket lands; returns the reduced pytree."""
+        return self._aggregate.wait(timeout)
+
+    def get_future(self) -> Future[Any]:
+        return self._aggregate

@@ -168,17 +168,24 @@ def run(
                 tx = HTTPTransport(timeout=30.0,
                                    state_dict_template=template_fn)
             if attempts == 2:
-                # the rejoiner's heal transfer, isolated from quorum time
-                inner_recv = tx.recv_checkpoint
+                # the rejoiner's heal transfer, isolated from quorum time.
+                # Both receive entry points are wrapped: multi-source
+                # transports (HTTP) are healed through
+                # recv_checkpoint_multi, single-source ones (PG) through
+                # recv_checkpoint.
+                def _timed(inner):
+                    def wrapped(*a, **k):
+                        t0 = time.perf_counter()
+                        out = inner(*a, **k)
+                        heal_recv_s[0] = time.perf_counter() - t0
+                        heal_stream[0] = tx.last_recv_timings()
+                        return out
 
-                def timed_recv(*a, **k):
-                    t0 = time.perf_counter()
-                    out = inner_recv(*a, **k)
-                    heal_recv_s[0] = time.perf_counter() - t0
-                    heal_stream[0] = tx.last_recv_timings()
-                    return out
+                    return wrapped
 
-                tx.recv_checkpoint = timed_recv
+                tx.recv_checkpoint = _timed(tx.recv_checkpoint)
+                if hasattr(tx, "recv_checkpoint_multi"):
+                    tx.recv_checkpoint_multi = _timed(tx.recv_checkpoint_multi)
 
             pg = make_pg(collective_timeout)
             if rid == 0:

@@ -171,6 +171,14 @@ Json ManagerQuorumResult::to_json() const {
   j["recover_src_manager_address"] = recover_src_manager_address;
   j["recover_src_replica_rank"] =
       recover_src_replica_rank ? Json(*recover_src_replica_rank) : Json();
+  Json fallbacks = Json::array();
+  for (const auto& f : recover_src_fallbacks) {
+    Json fj = Json::object();
+    fj["replica_rank"] = f.replica_rank;
+    fj["address"] = f.address;
+    fallbacks.push_back(fj);
+  }
+  j["recover_src_fallbacks"] = fallbacks;
   Json dsts = Json::array();
   for (auto r : recover_dst_replica_ranks) dsts.push_back(r);
   j["recover_dst_replica_ranks"] = dsts;
@@ -261,6 +269,22 @@ ManagerQuorumResult compute_quorum_results(const std::string& replica_id,
       recover_src_replica_rank
           ? participants[static_cast<size_t>(*recover_src_replica_rank)].address
           : "";
+  if (recover_src_replica_rank) {
+    // Remaining up-to-date peers in round-robin order starting just after
+    // the assigned source, so concurrent failovers spread across sources
+    // the same way the primary assignment does.
+    size_t src_pos = 0;
+    for (size_t i = 0; i < up_to_date.size(); ++i)
+      if (static_cast<int64_t>(up_to_date[i]) == *recover_src_replica_rank)
+        src_pos = i;
+    for (size_t i = 1; i < up_to_date.size(); ++i) {
+      size_t idx = up_to_date[(src_pos + i) % up_to_date.size()];
+      FallbackPeer f;
+      f.replica_rank = static_cast<int64_t>(idx);
+      f.address = participants[idx].address;
+      r.recover_src_fallbacks.push_back(f);
+    }
+  }
   auto it = assignments.find(static_cast<size_t>(replica_rank));
   if (it != assignments.end()) r.recover_dst_replica_ranks = it->second;
   r.store_address = primary.store_address;

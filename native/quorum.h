@@ -76,10 +76,19 @@ std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
 bool quorum_changed(const std::vector<QuorumMember>& a,
                     const std::vector<QuorumMember>& b);
 
+struct FallbackPeer {
+  int64_t replica_rank = 0;
+  std::string address;  // manager RPC address (host:port)
+};
+
 struct ManagerQuorumResult {
   int64_t quorum_id = 0;
   std::string recover_src_manager_address;
   std::optional<int64_t> recover_src_replica_rank;
+  // Other up-to-date (max_step) peers a healing replica can fail over to if
+  // the assigned source dies mid-transfer, rotated to continue round-robin
+  // after the assigned source. Empty unless heal is set.
+  std::vector<FallbackPeer> recover_src_fallbacks;
   std::vector<int64_t> recover_dst_replica_ranks;
   std::string store_address;
   int64_t max_step = 0;

@@ -16,6 +16,12 @@ immediately after heals alike).
 Chaos tooling parity: the reference drives this style of testing
 externally via its slurm punisher (examples/slurm/punisher.py kill_loop);
 here it is in-suite and seeded for reproducibility.
+
+The resilient-recovery-plane phase additionally restarts the lighthouse
+on its original port mid-soak (a control-plane outage the retry layers
+must ride out) and arms mid-serve connection drops on random serving
+transports (heal sources dying mid-transfer, forcing ranged resume or
+multi-peer failover).
 """
 
 from __future__ import annotations
@@ -74,6 +80,24 @@ SOAK_PHASES = [
     ("device", "pg", "dynamic", 3, 15.0),
     ("device", "http", "fixed_with_spares", 3, 15.0),
 ]
+
+
+@pytest.mark.slow
+def test_lighthouse_restart_and_mid_heal_source_kills():
+    """Resilient-recovery-plane chaos phases: (a) the lighthouse restarts
+    on the same port mid-soak — a control-plane outage shorter than the
+    quorum timeout that the jittered-backoff retry layer (native quorum
+    worker + Python client retries) must absorb as slower steps; (b)
+    serving transports get one-shot mid-serve connection drops armed at
+    random, so heals can lose their source mid-transfer and must resume
+    from the last verified byte or fail over to another up-to-date peer.
+    Same bar as every phase: finish, bitwise-equal survivors, >=1 heal."""
+    rng = random.Random(0xFA110)
+    _run_soak_phase(
+        rng, "host", "http", "dynamic", N_REPLICAS, CHAOS_SECONDS,
+        target=TARGET_STEPS, lighthouse_restart=True,
+        heal_source_faults=True,
+    )
 
 
 @pytest.mark.slow
@@ -206,7 +230,8 @@ def test_slow_rendezvous_timeout_discards_step_then_heals(caplog):
 
 
 def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
-                    chaos_seconds, target=20):
+                    chaos_seconds, target=20, lighthouse_restart=False,
+                    heal_source_faults=False):
     import jax.numpy as jnp
 
     from torchft_tpu.manager import WorldSizeMode
@@ -222,6 +247,13 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
         bind="127.0.0.1:0", min_replicas=min_survivors, join_timeout_ms=1000,
         quorum_tick_ms=20, heartbeat_timeout_ms=800,
     )
+    # mutable so the chaos thread can restart the lighthouse mid-soak; the
+    # port is pinned so every replica's stored address stays valid
+    lh_box = [lh]
+    lh_port = lh.port
+    # rid -> that incarnation's serving checkpoint transport, so chaos can
+    # arm mid-serve connection drops (a heal source dying mid-transfer)
+    serving: dict = {}
     kill_flags = [threading.Event() for _ in range(n_replicas)]
     alive = [threading.Event() for _ in range(n_replicas)]
     stop_chaos = threading.Event()
@@ -280,12 +312,13 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
                 min_replica_size=min_survivors,
                 use_async_quorum=(plane == "host"),
                 replica_id=f"soak_{plane}_{transport_kind}_{rid}",
-                lighthouse_addr=f"127.0.0.1:{lh.port}",
+                lighthouse_addr=f"127.0.0.1:{lh_port}",
                 timeout=8.0,
                 quorum_timeout=8.0,
                 checkpoint_transport=transport,
                 world_size_mode=wsm,
             )
+            serving[rid] = manager._checkpoint_transport
             alive[rid].set()
             died = False
             incarnation_last = manager.current_step()
@@ -382,8 +415,36 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
 
     def chaos() -> None:
         deadline = time.monotonic() + chaos_seconds
+        restart_at = time.monotonic() + chaos_seconds / 2
+        restarted = False
         while time.monotonic() < deadline and not stop_chaos.is_set():
             time.sleep(rng.uniform(*KILL_PERIOD))
+            if lighthouse_restart and not restarted and \
+                    time.monotonic() >= restart_at:
+                # control-plane outage phase: the lighthouse process dies
+                # and comes back on the SAME port, with the gap well inside
+                # the 8s quorum timeout. Heartbeats and quorum RPCs must
+                # ride it out via their bounded retry layers — replicas see
+                # slower steps, never errors they can't absorb.
+                restarted = True
+                lh_box[0].shutdown()
+                time.sleep(0.4)
+                for _ in range(25):
+                    try:
+                        lh_box[0] = LighthouseServer(
+                            bind=f"127.0.0.1:{lh_port}",
+                            min_replicas=min_survivors,
+                            join_timeout_ms=1000, quorum_tick_ms=20,
+                            heartbeat_timeout_ms=800,
+                        )
+                        break
+                    except Exception:
+                        time.sleep(0.2)
+                else:
+                    raise RuntimeError(
+                        f"could not rebind lighthouse on port {lh_port}"
+                    )
+                continue
             # a flagged-but-not-yet-dead victim counts as dead: it may be
             # blocked in a collective for seconds before polling its flag,
             # and counting it live could condemn every replica at once
@@ -391,6 +452,15 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
                 r for r in range(n_replicas)
                 if alive[r].is_set() and not kill_flags[r].is_set()
             ]
+            if heal_source_faults and live and rng.random() < 0.5:
+                # recovery-plane fault: the next serve of chunk 0 from this
+                # replica drops mid-transfer. If a heal happens to be (or
+                # get) in flight against it, the receiver must resume from
+                # its last verified byte or fail over to another peer; if
+                # not, the one-shot fault burns on the next init-sync serve.
+                t = serving.get(rng.choice(live))
+                if t is not None and hasattr(t, "inject_chunk_fault"):
+                    t.inject_chunk_fault(0, "die", times=1)
             if len(live) <= min_survivors:
                 continue
             kill_flags[rng.choice(live)].set()
@@ -405,7 +475,7 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
     finally:
         stop_chaos.set()
         ex.shutdown(wait=False, cancel_futures=True)
-        lh.shutdown()
+        lh_box[0].shutdown()
 
     label = f"{plane}/{transport_kind}/{mode}"
     assert set(finals) == set(range(n_replicas)), (label, finals.keys())

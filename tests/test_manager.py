@@ -48,6 +48,10 @@ def make_manager(pg=None, quorum=None, use_async_quorum=True, **kwargs):
     pg = pg or ProcessGroupDummy()
     transport = MagicMock()
     transport.metadata.return_value = "mock://ckpt"
+    # default to the single-source heal path: a bare MagicMock attribute is
+    # truthy, which would silently reroute recv_checkpoint mocks through
+    # recv_checkpoint_multi. Multi-source tests flip this explicitly.
+    transport.supports_multi_source = False
     with (
         patch("torchft_tpu.manager.ManagerServer") as server,
         patch("torchft_tpu.manager.KvStoreServer") as store,

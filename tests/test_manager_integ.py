@@ -46,6 +46,12 @@ class Runner:
     # store fault): recovery must come from the commit-failure quorum bump
     # re-rendezvousing EVERY replica, not a one-sided retry
     transport_configure_fails: int = 0
+    # override the HTTP transport's own timeout ("http" mode only). Shrinks
+    # the serve-side disallow grace window, which otherwise stalls a source
+    # whose expected fetch never completes (e.g. the healer failed over to
+    # another peer) right up against the 10s allreduce deadline of the rest
+    # of the cohort.
+    http_timeout: float = 0.0
 
     def run(self) -> Dict[str, np.ndarray]:
         for attempt in range(self.attempts):
@@ -69,7 +75,11 @@ class Runner:
 
         pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
         transport = None
-        if self.transport == "http-inplace":
+        if self.transport == "http" and self.http_timeout > 0:
+            from torchft_tpu.checkpointing import HTTPTransport
+
+            transport = HTTPTransport(timeout=self.http_timeout)
+        elif self.transport == "http-inplace":
             from torchft_tpu.checkpointing import HTTPTransport
 
             transport = HTTPTransport(
@@ -124,7 +134,13 @@ class Runner:
         )
         try:
             while manager.current_step() < self.total_steps:
-                self.injector.check(self.replica_id, manager.current_step(), pg)
+                # the replica's own serving transport rides along so
+                # network-shaped events (kill/corrupt the heal source) can
+                # arm serve-side faults on it
+                self.injector.check(
+                    self.replica_id, manager.current_step(), pg,
+                    transport=manager._checkpoint_transport,
+                )
                 manager.start_quorum()
                 # toy "gradient": depends on params so divergence would show
                 grads = {"w": (params["w"] * 0.1 + 1.0).astype(np.float32)}
@@ -132,7 +148,8 @@ class Runner:
                 if manager.should_commit():
                     params["w"] = (params["w"] - LR * reduced["w"]).astype(np.float32)
             return {"w": params["w"].copy(), "steps": manager.current_step(),
-                    "batches": manager.batches_committed()}
+                    "batches": manager.batches_committed(),
+                    "timings": manager.timings(), "metrics": manager.metrics()}
         finally:
             manager.shutdown(wait=False)
             if transport is not None and hasattr(transport, "_pg"):
@@ -239,6 +256,102 @@ class TestRecovery:
         assert injector.count == 2
         assert_params_equal(results)
         assert all(r["steps"] == NUM_STEPS for r in results)
+
+
+class TestResilientHeal:
+    """ISSUE 4 acceptance: multi-peer heal failover, integrity-checked
+    chunks, and bounded-retry control-plane RPCs — end to end through real
+    Managers, lighthouse, and HTTP transports.
+
+    Source assignment is deterministic: participants sort by replica_id, so
+    with replica 2 recovering and group_rank 0 the assigned source is
+    replica 0 and the fallback peer replica 1 (native quorum.cc round-robin).
+    """
+
+    def test_source_death_mid_heal_fails_over_and_commits(
+        self, lighthouse, monkeypatch
+    ):
+        """Replica 2 crashes and rejoins; its assigned heal source (replica
+        0) drops every serve of chunk 0. The heal must exhaust the
+        same-source budget, fail over to replica 1's standby snapshot,
+        commit that same step, and converge bitwise."""
+        monkeypatch.setenv("TORCHFT_RETRY_MAX_ATTEMPTS", "2")
+        monkeypatch.setenv("TORCHFT_RETRY_BASE_S", "0.01")
+        injector = (
+            EventInjector()
+            .fail_at(replica=2, step=2)
+            .kill_heal_source_at(replica=0, step=2, chunk=0, times=-1)
+        )
+        addr = f"127.0.0.1:{lighthouse.port}"
+        # min_replica_size=3 keeps the survivors blocked in quorum while
+        # replica 2 restarts, so the rejoin is guaranteed to go through a
+        # heal rather than the survivors finishing and shutting down first
+        results = run_replicas(
+            [Runner(i, addr, injector, min_replica_size=3, http_timeout=3.0)
+             for i in range(3)]
+        )
+        assert injector.count == 2  # the crash + the armed source kill
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+        healed = results[2]
+        assert healed["timings"]["heal_failovers"] >= 1
+        assert healed["timings"]["heal_attempts"] >= 1
+        assert healed["metrics"]["heals"] >= 1
+        assert healed["metrics"]["errors"] == 0  # degraded, never errored
+
+    def test_corrupt_chunk_refetched_never_loaded(self, lighthouse):
+        """Replica 2's heal source serves one corrupted chunk (canonical
+        crc trailer): the receiver must detect the mismatch, re-fetch, and
+        converge bitwise — corrupt bytes are never loaded."""
+        injector = (
+            EventInjector()
+            .fail_at(replica=2, step=2)
+            .corrupt_heal_chunk_at(replica=0, step=2, chunk=0, times=1)
+        )
+        addr = f"127.0.0.1:{lighthouse.port}"
+        results = run_replicas(
+            [Runner(i, addr, injector, min_replica_size=3) for i in range(3)]
+        )
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+        healed = results[2]
+        assert healed["timings"]["chunk_crc_failures"] >= 1
+        assert healed["metrics"]["errors"] == 0
+
+    def test_control_plane_blip_degrades_to_slower_step(self, lighthouse):
+        """A one-shot should_commit RPC flake (shorter than the quorum
+        timeout) must yield a successful, merely slower step: rpc_retries
+        > 0 somewhere, zero errors, full convergence."""
+        injector = EventInjector().flake_rpc(
+            "should_commit", times=1, delay_s=0.05
+        )
+        addr = f"127.0.0.1:{lighthouse.port}"
+        try:
+            results = run_replicas(
+                [Runner(i, addr, injector, min_replica_size=2) for i in range(2)]
+            )
+        finally:
+            injector.clear_rpc_faults()
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+        assert sum(r["timings"]["rpc_retries"] for r in results) >= 1
+        assert all(r["metrics"]["errors"] == 0 for r in results)
+
+    def test_quorum_rpc_flake_retries_cleanly(self, lighthouse):
+        """Same, for the quorum RPC itself — the blip lands inside the
+        overlapped quorum window and the step completes."""
+        injector = EventInjector().flake_rpc("quorum", times=1)
+        addr = f"127.0.0.1:{lighthouse.port}"
+        try:
+            results = run_replicas(
+                [Runner(i, addr, injector, min_replica_size=2) for i in range(2)]
+            )
+        finally:
+            injector.clear_rpc_faults()
+        assert_params_equal(results)
+        assert all(r["steps"] == NUM_STEPS for r in results)
+        assert sum(r["timings"]["rpc_retries"] for r in results) >= 1
+        assert all(r["metrics"]["errors"] == 0 for r in results)
 
 
 class TestPGTransportHealing:

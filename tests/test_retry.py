@@ -1,0 +1,225 @@
+"""Unit tests for torchft_tpu.retry: jittered backoff under a deadline
+budget, the per-attempt observability hook, and the zero-retry env config
+(``TORCHFT_RETRY_*``) preserving exact single-attempt semantics."""
+
+import random
+
+import pytest
+
+from torchft_tpu.retry import (
+    RETRY_BASE_S_ENV,
+    RETRY_JITTER_ENV,
+    RETRY_MAX_ATTEMPTS_ENV,
+    RETRY_MAX_BACKOFF_S_ENV,
+    RetryBudgetExhausted,
+    RetryPolicy,
+    retry_call,
+)
+
+
+class FakeClock:
+    """Deterministic monotonic clock; sleep() advances it."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+        self.sleeps = []
+
+    def clock(self) -> float:
+        return self.now
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.now += s
+
+
+class TestRetryPolicy:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_s=-1.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.5)
+
+    def test_enabled(self):
+        assert not RetryPolicy(max_attempts=1).enabled
+        assert RetryPolicy(max_attempts=2).enabled
+
+    def test_backoff_doubles_up_to_ceiling(self):
+        p = RetryPolicy(max_attempts=10, base_s=0.1, max_backoff_s=0.35, jitter=0.0)
+        assert p.backoff_s(1) == 0.0
+        assert p.backoff_s(2) == pytest.approx(0.1)
+        assert p.backoff_s(3) == pytest.approx(0.2)
+        # 0.4 would exceed the ceiling; clamped
+        assert p.backoff_s(4) == pytest.approx(0.35)
+        assert p.backoff_s(9) == pytest.approx(0.35)
+
+    def test_jitter_only_shortens(self):
+        """Jitter draws subtract from the backoff: every sample lies in
+        [backoff*(1-jitter), backoff], so max_backoff_s is a hard ceiling."""
+        p = RetryPolicy(max_attempts=5, base_s=0.2, max_backoff_s=1.0, jitter=0.5)
+        rng = random.Random(1234)
+        for attempt in (2, 3, 4):
+            ceiling = min(0.2 * 2 ** (attempt - 2), 1.0)
+            for _ in range(200):
+                s = p.backoff_s(attempt, rng)
+                assert ceiling * 0.5 <= s <= ceiling
+
+    def test_from_env_precedence(self, monkeypatch):
+        # env > explicit arg > default
+        monkeypatch.setenv(RETRY_MAX_ATTEMPTS_ENV, "7")
+        monkeypatch.setenv(RETRY_BASE_S_ENV, "0.25")
+        monkeypatch.delenv(RETRY_MAX_BACKOFF_S_ENV, raising=False)
+        monkeypatch.delenv(RETRY_JITTER_ENV, raising=False)
+        p = RetryPolicy.from_env(max_attempts=2, max_backoff_s=9.0)
+        assert p.max_attempts == 7  # env beats the explicit 2
+        assert p.base_s == 0.25
+        assert p.max_backoff_s == 9.0  # explicit beats default
+        assert p.jitter == RetryPolicy().jitter  # default
+
+
+class TestRetryCall:
+    def test_success_first_attempt_gets_full_budget(self):
+        seen = []
+        out = retry_call(
+            lambda remaining: seen.append(remaining) or "ok",
+            RetryPolicy(max_attempts=3, base_s=0.0, jitter=0.0),
+            timeout=5.0,
+        )
+        assert out == "ok"
+        assert seen == [5.0]
+
+    def test_retries_then_succeeds(self):
+        clk = FakeClock()
+        calls = []
+
+        def fn(remaining):
+            calls.append(remaining)
+            if len(calls) < 3:
+                raise ConnectionError("blip")
+            return "recovered"
+
+        out = retry_call(
+            fn,
+            RetryPolicy(max_attempts=5, base_s=0.1, max_backoff_s=1.0, jitter=0.0),
+            timeout=10.0,
+            clock=clk.clock,
+            sleep=clk.sleep,
+        )
+        assert out == "recovered"
+        assert len(calls) == 3
+        assert clk.sleeps == pytest.approx([0.1, 0.2])
+        # later attempts see the shrinking budget, never the full timeout
+        assert calls[1] == pytest.approx(10.0 - 0.1)
+        assert calls[2] == pytest.approx(10.0 - 0.3)
+
+    def test_deadline_budget_exhaustion(self):
+        """A deadline shorter than the backoff schedule stops the loop even
+        with attempts left, and the sleeps never overshoot the budget."""
+        clk = FakeClock()
+
+        def fn(remaining):
+            clk.now += 0.4  # each attempt burns 0.4s of the 1.0s budget
+            raise TimeoutError("slow")
+
+        with pytest.raises(RetryBudgetExhausted) as ei:
+            retry_call(
+                fn,
+                RetryPolicy(max_attempts=100, base_s=0.5, max_backoff_s=0.5, jitter=0.0),
+                timeout=1.0,
+                clock=clk.clock,
+                sleep=clk.sleep,
+            )
+        assert ei.value.attempts < 100  # the budget, not attempts, ended it
+        assert isinstance(ei.value.last_exception, TimeoutError)
+        assert isinstance(ei.value, TimeoutError)  # taxonomy: budget == timeout
+        for s in clk.sleeps:
+            assert s <= 1.0
+
+    def test_attempts_exhausted_raises_from_last(self):
+        err = ConnectionError("persistent")
+        with pytest.raises(RetryBudgetExhausted) as ei:
+            retry_call(
+                lambda r: (_ for _ in ()).throw(err),
+                RetryPolicy(max_attempts=3, base_s=0.0, jitter=0.0),
+                timeout=10.0,
+            )
+        assert ei.value.attempts == 3
+        assert ei.value.last_exception is err
+        assert ei.value.__cause__ is err
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def fn(remaining):
+            calls.append(1)
+            raise LookupError("semantic, not transient")
+
+        with pytest.raises(LookupError):
+            retry_call(
+                fn,
+                RetryPolicy(max_attempts=5, base_s=0.0, jitter=0.0),
+                timeout=10.0,
+                retryable=(ConnectionError, TimeoutError),
+            )
+        assert len(calls) == 1
+
+    def test_on_attempt_hook(self):
+        events = []
+
+        fails = iter([True, True, False])
+
+        def fn(remaining):
+            if next(fails):
+                raise ConnectionError("blip")
+            return "ok"
+
+        retry_call(
+            fn,
+            RetryPolicy(max_attempts=3, base_s=0.0, jitter=0.0),
+            timeout=10.0,
+            on_attempt=lambda attempt, prior: events.append((attempt, prior)),
+        )
+        assert [a for a, _ in events] == [1, 2, 3]
+        assert events[0][1] is None
+        assert isinstance(events[1][1], ConnectionError)
+
+    def test_single_attempt_preserves_original_exception(self):
+        """max_attempts=1 must be bit-compatible with having no retry layer:
+        one call, no sleep, the original exception type."""
+        clk = FakeClock()
+        err = RuntimeError("original")
+        calls = []
+
+        def fn(remaining):
+            calls.append(remaining)
+            raise err
+
+        with pytest.raises(RuntimeError) as ei:
+            retry_call(
+                fn,
+                RetryPolicy(max_attempts=1),
+                timeout=10.0,
+                clock=clk.clock,
+                sleep=clk.sleep,
+            )
+        assert ei.value is err  # not wrapped, not chained
+        assert calls == [10.0]
+        assert clk.sleeps == []
+
+    def test_zero_retry_env_config(self, monkeypatch):
+        """TORCHFT_RETRY_MAX_ATTEMPTS=1 disables retries cleanly through the
+        default-policy path (policy=None -> from_env)."""
+        monkeypatch.setenv(RETRY_MAX_ATTEMPTS_ENV, "1")
+        assert not RetryPolicy.from_env().enabled
+        err = ConnectionError("once")
+        calls = []
+
+        def fn(remaining):
+            calls.append(1)
+            raise err
+
+        with pytest.raises(ConnectionError) as ei:
+            retry_call(fn, timeout=5.0)
+        assert ei.value is err
+        assert len(calls) == 1

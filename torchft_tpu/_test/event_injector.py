@@ -2,12 +2,19 @@
 
 Mirror of the reference EventInjector (manager_integ_test.py:88-166):
 events fire at a given (replica, step) — process failure, allreduce future
-failure, or a barrier.
+failure, or a barrier. On top of the reference's process-shaped faults this
+injector also schedules NETWORK-shaped ones for the resilient recovery
+plane: kill-the-heal-source-mid-transfer at chunk k / corrupt chunk k
+(armed on the serving transport via ``HTTPTransport.inject_chunk_fault``)
+and delayed/flaky control-plane RPCs (installed process-wide via
+``coordination.set_rpc_fault_hook``), so the retry/failover machinery can
+be exercised deterministically.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Dict, Optional, Tuple
@@ -25,12 +32,18 @@ class EventKind(Enum):
     FAILURE = "failure"
     ALLREDUCE_FAILURE = "allreduce_failure"
     BARRIER = "barrier"
+    # network-shaped: arm a serve-side chunk fault on the replica's own
+    # checkpoint transport — it fires when a HEALING PEER fetches from it
+    HEAL_SOURCE_KILL = "heal_source_kill"
+    HEAL_CHUNK_CORRUPT = "heal_chunk_corrupt"
 
 
 @dataclass
 class _Event:
     kind: EventKind
     fired: bool = False
+    chunk: int = 0
+    times: int = 1  # serve count for the heal-source faults; -1 = every serve
 
 
 class EventInjector:
@@ -45,6 +58,9 @@ class EventInjector:
         self._prepare_gate: Optional[threading.Event] = None
         self._prepare_stalled = threading.Event()
         self._stall_key: Optional[Tuple[int, int]] = None
+        # method -> (remaining fire count, delay_s, error); drained by the
+        # process-wide rpc fault hook installed by flake_rpc
+        self._rpc_faults: Dict[str, Tuple[int, float, Optional[Exception]]] = {}
         self.count = 0
 
     def stall_prepare_at(self, replica: int, step: int) -> "EventInjector":
@@ -101,10 +117,87 @@ class EventInjector:
             self._barrier = threading.Barrier(parties)
         return self
 
+    def kill_heal_source_at(
+        self, replica: int, step: int, chunk: int = 0, times: int = 1
+    ) -> "EventInjector":
+        """When ``replica`` reaches ``step``, arm its checkpoint transport
+        to DROP the connection partway through serving ``chunk`` — the
+        healing peer sees a mid-transfer source death and must resume on
+        the same source or fail over to a fallback peer. ``times=-1``
+        faults every serve (a permanently-dead source: same-source resume
+        can never finish, forcing failover)."""
+        with self._lock:
+            self._events[(replica, step)] = _Event(
+                EventKind.HEAL_SOURCE_KILL, chunk=chunk, times=times
+            )
+        return self
+
+    def corrupt_heal_chunk_at(
+        self, replica: int, step: int, chunk: int = 0, times: int = 1
+    ) -> "EventInjector":
+        """When ``replica`` reaches ``step``, arm its checkpoint transport
+        to flip one payload byte of ``chunk`` (crc trailer stays canonical)
+        — the healing peer must detect the mismatch and re-fetch."""
+        with self._lock:
+            self._events[(replica, step)] = _Event(
+                EventKind.HEAL_CHUNK_CORRUPT, chunk=chunk, times=times
+            )
+        return self
+
+    # ------------------------------------------------- control-plane flakes
+    def flake_rpc(
+        self,
+        method: str,
+        times: int = 1,
+        delay_s: float = 0.0,
+        error: Optional[Exception] = None,
+    ) -> "EventInjector":
+        """Make the next ``times`` calls of RPC ``method`` (process-wide,
+        any client) sleep ``delay_s`` and then fail with ``error`` (default
+        a ``ConnectionError``) — the shape of a lighthouse/manager-server
+        blip. Exercises the jittered-backoff retry layer: a flake count
+        below the retry budget must degrade to a slower call, not an
+        errored one. Call :meth:`clear_rpc_faults` on teardown."""
+        from torchft_tpu import coordination
+
+        with self._lock:
+            self._rpc_faults[method] = (int(times), float(delay_s), error)
+        coordination.set_rpc_fault_hook(self._rpc_fault_hook)
+        return self
+
+    def clear_rpc_faults(self) -> None:
+        from torchft_tpu import coordination
+
+        with self._lock:
+            self._rpc_faults.clear()
+        coordination.set_rpc_fault_hook(None)
+
+    def _rpc_fault_hook(self, method: str, addr: str) -> Optional[Exception]:
+        with self._lock:
+            spec = self._rpc_faults.get(method)
+            if spec is None:
+                return None
+            times, delay_s, error = spec
+            if times <= 0:
+                return None
+            self._rpc_faults[method] = (times - 1, delay_s, error)
+            self.count += 1
+        if delay_s > 0:
+            time.sleep(delay_s)
+        return error if error is not None else ConnectionError(
+            f"injected rpc flake: {method} -> {addr}"
+        )
+
     def check(
-        self, replica: int, step: int, pg: Optional[FakeProcessGroupWrapper] = None
+        self,
+        replica: int,
+        step: int,
+        pg: Optional[FakeProcessGroupWrapper] = None,
+        transport: Optional[object] = None,
     ) -> None:
-        """Call once per (replica, step); fires at most once per event."""
+        """Call once per (replica, step); fires at most once per event.
+        ``transport`` (the replica's own checkpoint transport) is required
+        for the heal-source fault kinds."""
         with self._lock:
             event = self._events.get((replica, step))
             if event is None or event.fired:
@@ -112,6 +205,8 @@ class EventInjector:
             event.fired = True
             self.count += 1
             kind = event.kind
+            chunk = event.chunk
+            times = event.times
         if kind == EventKind.FAILURE:
             raise InjectedFailure(f"injected failure replica={replica} step={step}")
         if kind == EventKind.ALLREDUCE_FAILURE:
@@ -122,3 +217,9 @@ class EventInjector:
         if kind == EventKind.BARRIER:
             assert self._barrier is not None
             self._barrier.wait()
+        if kind in (EventKind.HEAL_SOURCE_KILL, EventKind.HEAL_CHUNK_CORRUPT):
+            assert transport is not None and hasattr(
+                transport, "inject_chunk_fault"
+            ), "heal-source faults need the replica's HTTP checkpoint transport"
+            mode = "die" if kind == EventKind.HEAL_SOURCE_KILL else "corrupt"
+            transport.inject_chunk_fault(chunk, mode, times=times)

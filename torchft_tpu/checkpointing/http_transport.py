@@ -17,9 +17,24 @@ which is what makes 12GB-class state dicts transferable at 8B scale.
 Wire chunks are BYTE ranges (``plan_wire_ranges``), not whole leaves: a
 single multi-GB fused parameter buffer splits across chunks, so parallel
 chunk fetches overlap its network transfer with the device placement of
-already-complete leaves instead of store-and-forwarding one blob. Wire
-version 2; v1 senders (whole-leaf ``[leaf_idx, nbytes]`` frames) are still
-understood on receive.
+already-complete leaves instead of store-and-forwarding one blob.
+
+Wire version 3 adds receiver-opt-in integrity + resume to the chunk wire:
+a ``crc=1`` query appends a 4-byte crc32 trailer over the canonical chunk
+body, and ``offset=N`` resumes the body mid-stream from byte ``N`` — the
+receiver keeps a running crc across reconnects, so a stalled transfer
+resumes from the last received byte and a corrupt chunk is detected and
+re-fetched instead of silently loaded into params. Both features ride
+query params the v2 server never saw, and a v3 receiver only sends them
+to peers whose metadata advertises v3, so v2<->v3 interop in either
+direction is byte-identical to v2. v1 senders (whole-leaf
+``[leaf_idx, nbytes]`` frames) are still understood on receive.
+
+``recv_checkpoint_multi`` layers mid-heal failover on top: an ordered list
+of candidate sources is tried under one deadline, and because
+``plan_wire_ranges`` is deterministic and every max-step peer stages the
+same state, a chunk half-fetched from a dying source resumes at the same
+byte offset on the next peer.
 """
 
 from __future__ import annotations
@@ -30,11 +45,16 @@ import socket
 import struct
 import threading
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchft_tpu.retry import RetryPolicy
 
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing._serialization import (
@@ -61,7 +81,8 @@ __all__ = ["HTTPTransport"]
 
 _FRAME = struct.Struct("<qq")  # v1: leaf_idx, nbytes (whole leaf)
 _FRAME_V2 = struct.Struct("<qqq")  # leaf_idx, offset, nbytes (byte range)
-_WIRE_VERSION = 2
+_CRC = struct.Struct("<I")  # v3 opt-in chunk trailer: crc32 of the body
+_WIRE_VERSION = 3
 # cap on auto-planned chunks (num_chunks=0): bounds fetch parallelism and
 # the per-chunk frame overhead on huge states
 _AUTO_MAX_CHUNKS = 8
@@ -96,9 +117,17 @@ class HTTPTransport(CheckpointTransport[Any]):
 
     def __init__(self, timeout: "float | timedelta" = 60.0, num_chunks: int = 0,
                  hostname: str = "",
-                 state_dict_template: "Optional[Any]" = None) -> None:
+                 state_dict_template: "Optional[Any]" = None,
+                 retry_policy: Optional[RetryPolicy] = None) -> None:
         self._timeout = _to_seconds(timeout)
         self._num_chunks = num_chunks
+        # per-chunk same-source retry budget + backoff for the recv side
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy.from_env()
+        )
+        # test-only serve-side fault injection (see inject_chunk_fault)
+        self._fault_lock = threading.Lock()
+        self._chunk_faults: List[Dict[str, int]] = []
         if state_dict_template is not None and not callable(state_dict_template):
             # same contract (and failure mode) as PGTransport: fail at
             # construction, not as an endlessly-retried heal error
@@ -148,13 +177,15 @@ class HTTPTransport(CheckpointTransport[Any]):
                     # receiver must time out rather than wedge
                     # disallow_checkpoint's write-acquire forever
                     self.connection.settimeout(transport._timeout)
-                    parts = self.path.strip("/").split("/")
-                    # /checkpoint/{step}/{what}
+                    raw_path, _, raw_query = self.path.partition("?")
+                    parts = raw_path.strip("/").split("/")
+                    # /checkpoint/{step}/{what}[?crc=1&offset=N]
                     if len(parts) != 3 or parts[0] != "checkpoint":
                         self.send_error(404, "unknown path")
                         return
                     step = int(parts[1])
                     what = parts[2]
+                    query = urllib.parse.parse_qs(raw_query)
                     # Acquire the read lock OUTSIDE the streaming block:
                     # socket.timeout IS TimeoutError (py>=3.10), so a
                     # mid-stream write timeout must never reach a handler
@@ -180,7 +211,9 @@ class HTTPTransport(CheckpointTransport[Any]):
                                 f"serving step {have}, asked {step}",
                             )
                             return
-                        if not transport._stream_response(self, staged, what):
+                        if not transport._stream_response(
+                            self, staged, what, query
+                        ):
                             self.send_error(404, f"unknown resource {what}")
                             return
                     except (BrokenPipeError, TimeoutError, OSError):
@@ -208,13 +241,43 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._serve_thread.start()
 
     # -- serving side -----------------------------------------------------
-    def _stream_response(self, handler: Any, staged: tuple, what: str) -> bool:
+    def inject_chunk_fault(self, chunk: int, mode: str, times: int = 1) -> None:
+        """Test-only: make the next ``times`` serves of ``chunk`` fail.
+
+        ``mode="corrupt"``: one payload byte of the served body is flipped
+        while the crc32 trailer stays canonical — the receiver detects the
+        mismatch and re-fetches. ``mode="die"``: the connection drops
+        roughly halfway through the requested span — models the source
+        dying mid-heal. ``times=-1`` faults every serve (a permanently-dead
+        source, forcing receiver failover)."""
+        if mode not in ("corrupt", "die"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        with self._fault_lock:
+            self._chunk_faults.append(
+                {"chunk": chunk, "mode": mode, "times": times}  # type: ignore[dict-item]
+            )
+
+    def _take_fault(self, chunk: int) -> Optional[str]:
+        with self._fault_lock:
+            for f in self._chunk_faults:
+                if f["chunk"] == chunk and f["times"] != 0:
+                    if f["times"] > 0:
+                        f["times"] -= 1
+                    return f["mode"]  # type: ignore[return-value]
+        return None
+
+    def _stream_response(
+        self, handler: Any, staged: tuple, what: str, query: dict
+    ) -> bool:
         """Write the response for ``what`` (True if the resource exists)
         from the captured ``staged`` snapshot.
 
         Chunk bodies stream straight from the staged arrays: per range a
         24-byte [leaf_idx, offset, nbytes] frame then the raw byte range —
-        never assembled in memory."""
+        never assembled in memory. ``offset=N`` serves the body from byte
+        ``N`` (resume); ``crc=1`` appends a 4-byte crc32 trailer over the
+        CANONICAL full body, so a resuming receiver's running crc still
+        verifies end to end."""
         _step, spec, payloads, assignments = staged
         if what == "metadata":
             body = pickle.dumps((spec, len(assignments), _WIRE_VERSION))
@@ -228,16 +291,51 @@ class HTTPTransport(CheckpointTransport[Any]):
             i = int(what[len("chunk_"):])
             if not (0 <= i < len(assignments)):
                 return False
+            want_crc = query.get("crc", ["0"])[0] == "1"
+            start = int(query.get("offset", ["0"])[0])
             ranges = assignments[i]
-            total = sum(_FRAME_V2.size + ln for (_j, _off, ln) in ranges)
+            body_len = sum(_FRAME_V2.size + ln for (_j, _off, ln) in ranges)
+            if start < 0 or start > body_len:
+                return False
+            fault = self._take_fault(i)
+            die_after: Optional[int] = None
+            if fault == "die":
+                # drop the connection roughly halfway through the span
+                die_after = max((body_len - start) // 2, 1)
+            total = body_len - start + (_CRC.size if want_crc else 0)
             handler.send_response(200)
             handler.send_header("Content-Type", "application/octet-stream")
             handler.send_header("Content-Length", str(total))
             handler.end_headers()
+            crc = 0
+            pos = 0  # canonical body cursor
+            written = 0
+            corrupt_pending = fault == "corrupt"
             for j, off, ln in ranges:
                 mv = payload_memoryview(payloads[j])
-                handler.wfile.write(_FRAME_V2.pack(j, off, ln))
-                handler.wfile.write(mv[off : off + ln])
+                for is_payload, seg in (
+                    (False, _FRAME_V2.pack(j, off, ln)),
+                    (True, mv[off : off + ln]),
+                ):
+                    seg_len = len(seg)
+                    if want_crc:
+                        crc = zlib.crc32(seg, crc)
+                    if pos + seg_len > start:
+                        lo = max(0, start - pos)
+                        out = seg[lo:]
+                        if corrupt_pending and is_payload and len(out):
+                            out = bytearray(out)
+                            out[0] ^= 0xFF
+                            corrupt_pending = False
+                        if die_after is not None and written + len(out) >= die_after:
+                            handler.wfile.write(out[: max(die_after - written, 0)])
+                            handler.close_connection = True
+                            return True
+                        handler.wfile.write(out)
+                        written += len(out)
+                    pos += seg_len
+            if want_crc:
+                handler.wfile.write(_CRC.pack(crc & 0xFFFFFFFF))
             with self._fetch_cond:
                 # only count serves of the CURRENT staging: a stale-snapshot
                 # serve completing after a restage must not satisfy the new
@@ -308,180 +406,401 @@ class HTTPTransport(CheckpointTransport[Any]):
             self._staged = None
 
     # -- receiving side ---------------------------------------------------
+    supports_multi_source = True
+
     def recv_checkpoint(self, src_rank: int, metadata: str, step: int, timeout) -> Any:
+        return self.recv_checkpoint_multi(
+            [(f"replica_rank_{src_rank}", lambda: metadata)], step, timeout
+        )
+
+    def recv_checkpoint_multi(
+        self,
+        sources: List[Tuple[str, Callable[[], str]]],
+        step: int,
+        timeout,
+        on_event: Optional[Callable[..., None]] = None,
+    ) -> Any:
+        """Fetch ``step`` from an ordered list of candidate sources under
+        one deadline, resuming and failing over mid-transfer.
+
+        Chunk progress (byte offset, running crc, pending credits) survives
+        a source switch: same-step peers stage identical states and
+        ``plan_wire_ranges`` is deterministic, so as long as the next peer's
+        metadata matches the plan signature, a half-fetched chunk continues
+        at its last received byte on the new peer. A signature mismatch
+        (different chunking config) restarts the receive from scratch."""
         timeout_s = _to_seconds(timeout)
-        base = f"{metadata}/checkpoint/{step}"
-
-        def fetch(url: str) -> bytes:
-            with urllib.request.urlopen(url, timeout=timeout_s) as r:
-                return r.read()
-
-        # tolerant unpack: v1 senders ship (spec, num_chunks), v2 appends
-        # the wire version — unknown trailing fields are ignored
-        spec, num_chunks, *meta_rest = pickle.loads(fetch(f"{base}/metadata"))
-        version = meta_rest[0] if meta_rest else 1
-        payloads: List[Optional[Any]] = [None] * len(spec.leaves)
-
-        template_leaves: Optional[List[Any]] = None
-        if self._template_fn is not None:
-            # returns None (one warning) when the sender's tree STRUCTURE
-            # differs from the template's — index-aligned placement would
-            # risk streaming leaves into the wrong buffers
-            template_leaves = template_leaves_for(
-                spec, self._template_fn(), logger
-            )
-
-        def _host_target(meta, leaf_idx):
-            """A host ndarray template leaf that can absorb this wire leaf
-            lets the socket stream DIRECTLY into the resident buffer —
-            zero wire-buffer alloc, the strongest in-place path."""
-            if template_leaves is None or meta.kind != "array":
-                return None
-            t = template_leaves[leaf_idx]
-            if can_absorb(t, meta.shape, meta.dtype, require_contiguous=True):
-                return t
-            return None
-
-        # Per-leaf reassembly: ranges of one leaf may arrive on different
-        # chunk-fetch threads, so the recv buffer is allocated once under a
-        # lock and a bytes-remaining counter triggers finalization (device
-        # placement / bytes conversion) exactly once, on the thread that
-        # lands the last range — placement of a completed leaf overlaps
-        # the wire transfer of the chunks still streaming.
-        buf_lock = threading.Lock()
-        buffers: List[Optional[Any]] = [None] * len(spec.leaves)
-        direct: List[bool] = [False] * len(spec.leaves)
-        remaining: List[int] = [m.nbytes for m in spec.leaves]
-
-        def _buffer_for(leaf_idx: int) -> Any:
-            with buf_lock:
-                if buffers[leaf_idx] is None:
-                    meta = spec.leaves[leaf_idx]
-                    if meta.kind == "array":
-                        target = _host_target(meta, leaf_idx)
-                        if target is not None:
-                            buffers[leaf_idx] = target
-                            direct[leaf_idx] = True
-                        else:
-                            buffers[leaf_idx] = alloc_leaf(meta)
-                    else:
-                        buffers[leaf_idx] = bytearray(meta.nbytes)
-                return buffers[leaf_idx]
-
-        def _mark_written(leaf_idx: int, n: int) -> bool:
-            """Credit ``n`` received bytes; True when the leaf is complete
-            (finalize on the calling thread, outside the lock)."""
-            with buf_lock:
-                remaining[leaf_idx] -= n
-                if remaining[leaf_idx] < 0:
-                    raise ConnectionError(
-                        f"leaf {leaf_idx}: overlapping/duplicate wire ranges"
-                    )
-                return remaining[leaf_idx] == 0 and payloads[leaf_idx] is None
-
-        def _finish_leaf(leaf_idx: int) -> None:
-            meta = spec.leaves[leaf_idx]
-            arr = buffers[leaf_idx]
-            if meta.kind == "array":
-                if not direct[leaf_idx] and template_leaves is not None:
-                    # device template (device_put) or a mismatch
-                    # (warns "in-place receive degraded")
-                    arr = place_leaf_like(arr, template_leaves[leaf_idx], logger)
-                payloads[leaf_idx] = arr
-            else:
-                payloads[leaf_idx] = bytes(arr)
-
+        deadline = time.monotonic() + timeout_s
+        emit = on_event if on_event is not None else (lambda kind, **f: None)
         timings = StreamTimings()
-        stats_lock = threading.Lock()
+        t_all = time.perf_counter()
+        rs: Optional[_RecvState] = None
+        last_exc: Optional[Exception] = None
+        tried = 0
+        for src_i, (label, metadata_fn) in enumerate(sources):
+            if time.monotonic() >= deadline:
+                break
+            if src_i > 0:
+                timings.failovers += 1
+                emit("heal_failover", source=label, prior_error=repr(last_exc))
+            tried += 1
+            try:
+                base = f"{metadata_fn()}/checkpoint/{step}"
+                meta_timeout = min(
+                    timeout_s, max(deadline - time.monotonic(), 0.001)
+                )
+                with urllib.request.urlopen(
+                    f"{base}/metadata", timeout=meta_timeout
+                ) as r:
+                    raw_meta = r.read()
+            except Exception as e:  # noqa: BLE001 — any peer error -> next peer
+                last_exc = e
+                continue
+            # tolerant unpack: v1 senders ship (spec, num_chunks), v2+
+            # appends the wire version — unknown trailing fields ignored
+            spec, num_chunks, *meta_rest = pickle.loads(raw_meta)
+            version = meta_rest[0] if meta_rest else 1
+            sig = (num_chunks, tuple(m.nbytes for m in spec.leaves))
+            if rs is None or rs.sig != sig:
+                if rs is not None:
+                    logger.warning(
+                        "heal source %s plans %s, prior source planned %s; "
+                        "restarting the receive from scratch", label, sig, rs.sig
+                    )
+                rs = _RecvState(spec, num_chunks, self._template_fn)
+            try:
+                self._fetch_all(
+                    rs, base, version, deadline, timeout_s, timings, emit, label
+                )
+            except Exception as e:  # noqa: BLE001 — exhausted on this peer
+                last_exc = e
+                continue
+            # success: finalize zero-byte leaves (no range bytes on the
+            # wire), check completeness, reassemble
+            for i, rem in enumerate(rs.remaining):
+                if rem == 0 and rs.payloads[i] is None:
+                    rs.buffer_for(i)
+                    rs.finish_leaf(i)
+            missing = [i for i, p in enumerate(rs.payloads) if p is None]
+            if missing:
+                raise RuntimeError(f"checkpoint chunks missing leaves {missing}")
+            timings.total_s = time.perf_counter() - t_all
+            self._last_recv_timings = timings
+            return unflatten_state(rs.spec, rs.payloads)  # type: ignore[arg-type]
+        timings.total_s = time.perf_counter() - t_all
+        self._last_recv_timings = timings
+        raise RuntimeError(
+            f"heal failed: all {tried}/{len(sources)} source(s) exhausted "
+            f"within {timeout_s:.1f}s (last error: {last_exc!r})"
+        ) from last_exc
 
-        def fetch_chunk(i: int) -> None:
-            """Stream one chunk: read each range frame, then read the body
-            straight into the leaf's recv buffer at its offset."""
-            frame = _FRAME_V2 if version >= 2 else _FRAME
-            t0 = time.perf_counter()
-            chunk_bytes = 0
-            with urllib.request.urlopen(
-                f"{base}/chunk_{i}", timeout=timeout_s
-            ) as r:
-                while True:
-                    hdr = r.read(frame.size)
+    def _fetch_all(
+        self,
+        rs: "_RecvState",
+        base: str,
+        version: int,
+        deadline: float,
+        timeout_s: float,
+        timings: StreamTimings,
+        emit: Callable[..., None],
+        label: str,
+    ) -> None:
+        """Fetch every unfinished chunk from one source in parallel, with a
+        per-chunk same-source retry loop (resume on stall when the source
+        speaks v3, full chunk refetch on crc mismatch)."""
+        todo = [st for st in rs.chunk_states if not st.done]
+        if not todo:
+            return
+        policy = self._retry_policy
+
+        def run(st: "_ChunkFetch") -> None:
+            attempts = 0
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"heal deadline exhausted before chunk {st.i}"
+                    )
+                try:
+                    self._fetch_chunk_once(
+                        rs, st, base, version, min(timeout_s, remaining), timings
+                    )
+                    return
+                except _ChunkCrcError as e:
+                    # corrupt bytes are never credited/finalized: throw away
+                    # the chunk's progress and re-fetch it from byte 0
+                    st.reset()
+                    with rs.stats_lock:
+                        timings.crc_failures += 1
+                    emit("chunk_crc_failure", chunk=st.i, source=label)
+                    err: Exception = e
+                except (ConnectionError, TimeoutError, OSError) as e:
+                    if version < 3:
+                        # v2 peers can't serve a body suffix: restart chunk
+                        st.reset()
+                    err = e
+                attempts += 1
+                if attempts >= policy.max_attempts:
+                    raise err
+                with rs.stats_lock:
+                    timings.retries += 1
+                emit(
+                    "heal_retry",
+                    chunk=st.i,
+                    source=label,
+                    attempt=attempts,
+                    resume_offset=st.body_off,
+                    error=repr(err),
+                )
+                pause = policy.backoff_s(attempts + 1)
+                time.sleep(min(pause, max(deadline - time.monotonic(), 0)))
+
+        with ThreadPoolExecutor(max_workers=max(1, min(len(todo), 8))) as ex:
+            futs = [ex.submit(run, st) for st in todo]
+            errs = [f.exception() for f in futs]
+        for e in errs:
+            if e is not None:
+                raise e  # type: ignore[misc]
+
+    def _fetch_chunk_once(
+        self,
+        rs: "_RecvState",
+        st: "_ChunkFetch",
+        base: str,
+        version: int,
+        timeout_s: float,
+        timings: StreamTimings,
+    ) -> None:
+        """One streaming attempt at chunk ``st.i``: read range frames and
+        stream payloads straight into the leaf recv buffers, resuming from
+        ``st.body_off`` when the source speaks v3.
+
+        Leaf byte credits are DEFERRED to the chunk's pending list and only
+        applied after the whole chunk verifies (v3: crc trailer matches;
+        v1/v2: clean EOF), so a corrupt chunk can be re-fetched with the
+        buffer rewrites staying idempotent and no leaf is ever finalized
+        from unverified bytes."""
+        frame = _FRAME_V2 if version >= 2 else _FRAME
+        want_crc = version >= 3
+        url = f"{base}/chunk_{st.i}"
+        if want_crc:
+            params = ["crc=1"]
+            if st.body_off:
+                params.append(f"offset={st.body_off}")
+            url += "?" + "&".join(params)
+        t0 = time.perf_counter()
+        attempt_bytes = 0
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            while True:
+                if st.cur is None:
+                    hdr = _read_upto(r, frame.size)
                     if not hdr:
-                        break
+                        if want_crc:
+                            raise ConnectionError(
+                                f"chunk {st.i}: stream ended before crc trailer"
+                            )
+                        break  # v1/v2: clean end of chunk
+                    if want_crc and len(hdr) == _CRC.size:
+                        expected = _CRC.unpack(hdr)[0]
+                        if st.crc & 0xFFFFFFFF != expected:
+                            raise _ChunkCrcError(
+                                f"chunk {st.i}: crc32 mismatch "
+                                f"(got {st.crc & 0xFFFFFFFF:#010x}, "
+                                f"trailer {expected:#010x})"
+                            )
+                        break  # verified
                     if len(hdr) < frame.size:
+                        # partial header bytes are NOT counted in body_off,
+                        # so a resume re-reads the whole header
                         raise ConnectionError(
-                            f"chunk {i}: truncated frame header"
+                            f"chunk {st.i}: truncated frame header"
                         )
                     if version >= 2:
                         leaf_idx, off, nbytes = frame.unpack(hdr)
                     else:
                         leaf_idx, nbytes = frame.unpack(hdr)
                         off = 0
-                    if not (0 <= leaf_idx < len(spec.leaves)):
+                    if not (0 <= leaf_idx < len(rs.spec.leaves)):
                         raise ConnectionError(
-                            f"chunk {i}: frame names leaf {leaf_idx} of "
-                            f"{len(spec.leaves)}"
+                            f"chunk {st.i}: frame names leaf {leaf_idx} of "
+                            f"{len(rs.spec.leaves)}"
                         )
-                    meta = spec.leaves[leaf_idx]
+                    meta = rs.spec.leaves[leaf_idx]
                     if version < 2 and nbytes != meta.nbytes:
                         # a short v1 frame would exit the read loop cleanly
                         # and leave the leaf — possibly a live template
                         # buffer — half-written with no error
                         raise ConnectionError(
-                            f"chunk {i} leaf {leaf_idx}: frame carries "
+                            f"chunk {st.i} leaf {leaf_idx}: frame carries "
                             f"{nbytes} bytes but the leaf spec says "
                             f"{meta.nbytes}"
                         )
                     if off < 0 or nbytes < 0 or off + nbytes > meta.nbytes:
                         raise ConnectionError(
-                            f"chunk {i} leaf {leaf_idx}: range "
+                            f"chunk {st.i} leaf {leaf_idx}: range "
                             f"[{off}, {off + nbytes}) outside the leaf's "
                             f"{meta.nbytes} bytes"
                         )
-                    buf = _buffer_for(leaf_idx)
-                    if isinstance(buf, bytearray):
-                        mv = memoryview(buf)[off : off + nbytes]
-                    else:
-                        mv = memoryview(buf.reshape(-1).view("u1"))[
-                            off : off + nbytes
-                        ]
-                    got = 0
-                    while got < nbytes:
-                        n = r.readinto(mv[got:])
-                        if not n:
-                            raise ConnectionError(
-                                f"chunk {i} truncated at leaf {leaf_idx} "
-                                f"({got}/{nbytes} bytes of range)"
-                            )
-                        got += n
-                    chunk_bytes += nbytes
-                    if _mark_written(leaf_idx, nbytes):
-                        _finish_leaf(leaf_idx)
-            with stats_lock:
-                timings.chunks.append(
-                    ChunkStat(
-                        nbytes=chunk_bytes,
-                        transfer_s=time.perf_counter() - t0,
-                    )
+                    if want_crc:
+                        st.crc = zlib.crc32(hdr, st.crc)
+                    st.body_off += frame.size
+                    st.cur = (leaf_idx, off, nbytes, 0)
+                leaf_idx, off, nbytes, got = st.cur
+                buf = rs.buffer_for(leaf_idx)
+                if isinstance(buf, bytearray):
+                    span = memoryview(buf)[off : off + nbytes]
+                else:
+                    span = memoryview(buf.reshape(-1).view("u1"))[
+                        off : off + nbytes
+                    ]
+                while got < nbytes:
+                    n = r.readinto(span[got:])
+                    if not n:
+                        raise ConnectionError(
+                            f"chunk {st.i} truncated at leaf {leaf_idx} "
+                            f"({got}/{nbytes} bytes of range)"
+                        )
+                    if want_crc:
+                        st.crc = zlib.crc32(span[got : got + n], st.crc)
+                    st.body_off += n
+                    got += n
+                    st.cur = (leaf_idx, off, nbytes, got)
+                    attempt_bytes += n
+                st.pending.append((leaf_idx, nbytes))
+                st.cur = None
+        # chunk verified (or v1/v2-complete): apply the deferred credits,
+        # finalizing any leaves this chunk completed
+        for leaf_idx, n in st.pending:
+            if rs.mark_written(leaf_idx, n):
+                rs.finish_leaf(leaf_idx)
+        st.pending = []
+        st.done = True
+        with rs.stats_lock:
+            timings.chunks.append(
+                ChunkStat(
+                    nbytes=attempt_bytes,
+                    transfer_s=time.perf_counter() - t0,
                 )
-                timings.total_bytes += chunk_bytes
-
-        t_all = time.perf_counter()
-        with ThreadPoolExecutor(max_workers=max(1, min(num_chunks, 8))) as ex:
-            list(ex.map(fetch_chunk, range(num_chunks)))
-        timings.total_s = time.perf_counter() - t_all
-        # zero-byte leaves get no range bytes on v2 wires; finalize them
-        for i, rem in enumerate(remaining):
-            if rem == 0 and payloads[i] is None:
-                _buffer_for(i)
-                _finish_leaf(i)
-        missing = [i for i, p in enumerate(payloads) if p is None]
-        if missing:
-            raise RuntimeError(f"checkpoint chunks missing leaves {missing}")
-        self._last_recv_timings = timings
-        return unflatten_state(spec, payloads)  # type: ignore[arg-type]
+            )
+            timings.total_bytes += attempt_bytes
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()
         self._server.server_close()
         if wait:
             self._serve_thread.join(timeout=5)
+
+
+class _ChunkCrcError(ConnectionError):
+    """The chunk's crc32 trailer did not match the received body."""
+
+
+def _read_upto(r: Any, n: int) -> bytes:
+    """Read up to ``n`` bytes, short only at EOF (loops over short reads)."""
+    buf = b""
+    while len(buf) < n:
+        got = r.read(n - len(buf))
+        if not got:
+            break
+        buf += got
+    return buf
+
+
+class _ChunkFetch:
+    """Resumable per-chunk fetch state, surviving reconnects and source
+    failovers: ``body_off`` is the canonical-body byte to resume from,
+    ``crc`` the running crc32 of everything consumed so far, ``cur`` a
+    partially-read range ``(leaf_idx, off, nbytes, got)``, and ``pending``
+    the leaf byte credits deferred until the chunk verifies."""
+
+    __slots__ = ("i", "body_off", "crc", "cur", "pending", "done")
+
+    def __init__(self, i: int) -> None:
+        self.i = i
+        self.reset()
+
+    def reset(self) -> None:
+        self.body_off = 0
+        self.crc = 0
+        self.cur: Optional[Tuple[int, int, int, int]] = None
+        self.pending: List[Tuple[int, int]] = []
+        self.done = False
+
+
+class _RecvState:
+    """Shared reassembly state of one multi-source receive: recv buffers,
+    per-leaf byte accounting, and the per-chunk fetch states.
+
+    Per-leaf reassembly: ranges of one leaf may arrive on different
+    chunk-fetch threads, so the recv buffer is allocated once under a lock
+    and a bytes-remaining counter triggers finalization (device placement /
+    bytes conversion) exactly once, on the thread whose chunk lands the
+    leaf's last verified range — placement of completed leaves overlaps the
+    wire transfer of the chunks still streaming."""
+
+    def __init__(self, spec: Any, num_chunks: int, template_fn: Any) -> None:
+        self.spec = spec
+        self.num_chunks = num_chunks
+        self.sig = (num_chunks, tuple(m.nbytes for m in spec.leaves))
+        self.payloads: List[Optional[Any]] = [None] * len(spec.leaves)
+        self.template_leaves: Optional[List[Any]] = None
+        if template_fn is not None:
+            # returns None (one warning) when the sender's tree STRUCTURE
+            # differs from the template's — index-aligned placement would
+            # risk streaming leaves into the wrong buffers
+            self.template_leaves = template_leaves_for(spec, template_fn(), logger)
+        self.buf_lock = threading.Lock()
+        self.stats_lock = threading.Lock()
+        self.buffers: List[Optional[Any]] = [None] * len(spec.leaves)
+        self.direct: List[bool] = [False] * len(spec.leaves)
+        self.remaining: List[int] = [m.nbytes for m in spec.leaves]
+        self.chunk_states = [_ChunkFetch(i) for i in range(num_chunks)]
+
+    def _host_target(self, meta: Any, leaf_idx: int) -> Optional[Any]:
+        """A host ndarray template leaf that can absorb this wire leaf
+        lets the socket stream DIRECTLY into the resident buffer —
+        zero wire-buffer alloc, the strongest in-place path."""
+        if self.template_leaves is None or meta.kind != "array":
+            return None
+        t = self.template_leaves[leaf_idx]
+        if can_absorb(t, meta.shape, meta.dtype, require_contiguous=True):
+            return t
+        return None
+
+    def buffer_for(self, leaf_idx: int) -> Any:
+        with self.buf_lock:
+            if self.buffers[leaf_idx] is None:
+                meta = self.spec.leaves[leaf_idx]
+                if meta.kind == "array":
+                    target = self._host_target(meta, leaf_idx)
+                    if target is not None:
+                        self.buffers[leaf_idx] = target
+                        self.direct[leaf_idx] = True
+                    else:
+                        self.buffers[leaf_idx] = alloc_leaf(meta)
+                else:
+                    self.buffers[leaf_idx] = bytearray(meta.nbytes)
+            return self.buffers[leaf_idx]
+
+    def mark_written(self, leaf_idx: int, n: int) -> bool:
+        """Credit ``n`` verified bytes; True when the leaf is complete
+        (finalize on the calling thread, outside the lock)."""
+        with self.buf_lock:
+            self.remaining[leaf_idx] -= n
+            if self.remaining[leaf_idx] < 0:
+                raise ConnectionError(
+                    f"leaf {leaf_idx}: overlapping/duplicate wire ranges"
+                )
+            return self.remaining[leaf_idx] == 0 and self.payloads[leaf_idx] is None
+
+    def finish_leaf(self, leaf_idx: int) -> None:
+        meta = self.spec.leaves[leaf_idx]
+        arr = self.buffers[leaf_idx]
+        if meta.kind == "array":
+            if not self.direct[leaf_idx] and self.template_leaves is not None:
+                # device template (device_put) or a mismatch
+                # (warns "in-place receive degraded")
+                arr = place_leaf_like(arr, self.template_leaves[leaf_idx], logger)
+            self.payloads[leaf_idx] = arr
+        else:
+            self.payloads[leaf_idx] = bytes(arr)

@@ -14,8 +14,9 @@ from __future__ import annotations
 
 import logging
 import pickle
+import zlib
 from datetime import timedelta
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +41,14 @@ from torchft_tpu.process_group import ProcessGroup
 logger = logging.getLogger(__name__)
 
 __all__ = ["PGTransport"]
+
+
+def _chunk_crc(wires: List[np.ndarray], chunk: List[Tuple[int, int, int]]) -> int:
+    """crc32 over a chunk's concatenated range payloads, in plan order."""
+    crc = 0
+    for j, off, ln in chunk:
+        crc = zlib.crc32(wires[j][off : off + ln], crc)
+    return crc & 0xFFFFFFFF
 
 
 class PGTransport(CheckpointTransport[Any]):
@@ -161,20 +170,27 @@ class PGTransport(CheckpointTransport[Any]):
         # on receive for mixed-version heals.
         ranged = hasattr(self._pg, "recv_into")
         ranges: Optional[List[Any]] = None
-        if ranged:
-            chunk_bytes = min(self.BATCH_GROUP_BYTES, stream_chunk_bytes())
-            ranges = plan_wire_ranges(
-                [m.nbytes for m in spec.leaves], chunk_bytes
-            )
-            header = pickle.dumps((step, spec, "ranged", ranges))
-        else:
-            header = pickle.dumps((step, spec))
         wires = [
             buf.reshape(-1).view(np.uint8)
             if isinstance(buf, np.ndarray)
             else np.frombuffer(buf, dtype=np.uint8)
             for buf in payloads
         ]
+        if ranged:
+            chunk_bytes = min(self.BATCH_GROUP_BYTES, stream_chunk_bytes())
+            ranges = plan_wire_ranges(
+                [m.nbytes for m in spec.leaves], chunk_bytes
+            )
+            # per-chunk crc32 over the concatenated range payloads rides the
+            # header as a 5th element: pre-crc receivers unpack tolerantly
+            # and skip verification, pre-crc senders ship a 4-tuple and the
+            # receiver sees crcs=None — both directions interop
+            crcs = [
+                _chunk_crc(wires, chunk) for chunk in ranges
+            ]
+            header = pickle.dumps((step, spec, "ranged", ranges, crcs))
+        else:
+            header = pickle.dumps((step, spec))
         for dst in dst_ranks:
             self._pg.send([np.frombuffer(header, dtype=np.uint8)], dst, tag=1).wait(
                 self._timeout
@@ -260,7 +276,8 @@ class PGTransport(CheckpointTransport[Any]):
         payload_leaves: List[Any] = []
         if proto == "ranged":
             return self._recv_ranged(
-                src_rank, spec, rest[1], template_leaves, timeout_s
+                src_rank, spec, rest[1], template_leaves, timeout_s,
+                crcs=rest[2] if len(rest) > 2 else None,
             )
         if proto:
             # one message per wire group (same deterministic grouping as
@@ -338,12 +355,19 @@ class PGTransport(CheckpointTransport[Any]):
         ranges: List[List[Any]],
         template_leaves: Optional[List[Any]],
         timeout_s: float,
+        crcs: Optional[List[int]] = None,
     ) -> Any:
         """Receive the ranged wire: one message per chunk of byte ranges
         (the plan rode the header). The recv of chunk i+1 runs on a worker
         thread while this thread finalizes (device-places) the leaves
         chunk i completed — the pipelining that hides placement behind the
-        wire for multi-chunk heals."""
+        wire for multi-chunk heals.
+
+        ``crcs`` (when the sender's header carries them) are verified per
+        chunk after the copy into the destination views — detection only on
+        this push-based wire: a mismatch raises, the Manager's
+        discard-and-retry heal protocol re-requests the transfer, and the
+        corrupt bytes are never finalized into leaves."""
         recv_into = getattr(self._pg, "recv_into", None)
 
         # flat uint8 destination per leaf: absorb-capable template leaves
@@ -386,7 +410,8 @@ class PGTransport(CheckpointTransport[Any]):
                 leaf = place_leaf_like(leaf, template_leaves[i], logger)
             payloads[i] = leaf
 
-        def transfer(chunk: List[Any]) -> List[Any]:
+        def transfer(item: Any) -> List[Any]:
+            ci, chunk = item
             gviews = [dests[j][off : off + ln] for (j, off, ln) in chunk]
             if recv_into is not None:
                 got = self._pg.recv_into(gviews, src_rank, tag=2) \
@@ -417,6 +442,16 @@ class PGTransport(CheckpointTransport[Any]):
                         f"{buf.size} bytes, plan says {ln}"
                     )
                 np.copyto(gviews[k], buf)
+            if crcs is not None:
+                got_crc = 0
+                for gv in gviews:
+                    got_crc = zlib.crc32(gv, got_crc)
+                if got_crc & 0xFFFFFFFF != crcs[ci] & 0xFFFFFFFF:
+                    raise RuntimeError(
+                        f"ranged recv: chunk {ci} crc32 mismatch "
+                        f"(got {got_crc & 0xFFFFFFFF:#010x}, header says "
+                        f"{crcs[ci] & 0xFFFFFFFF:#010x}); discarding heal"
+                    )
             return chunk
 
         def finish(chunk: List[Any]) -> None:
@@ -431,7 +466,7 @@ class PGTransport(CheckpointTransport[Any]):
 
         timings = StreamTimings()
         pipelined(
-            ranges,
+            list(enumerate(ranges)),
             transfer,
             finish,
             depth=2,

@@ -114,6 +114,10 @@ class StreamTimings:
     total_bytes: int = 0
     total_s: float = 0.0
     chunks: List[ChunkStat] = field(default_factory=list)
+    # resilience counters of the recv (multi-source transports only):
+    retries: int = 0  # same-source stall resumes / refetches
+    failovers: int = 0  # mid-heal switches to a fallback source
+    crc_failures: int = 0  # chunks refetched after a crc32 mismatch
 
     @property
     def num_chunks(self) -> int:
@@ -234,6 +238,33 @@ class CheckpointTransport(ABC, Generic[T]):
         self, src_rank: int, metadata: str, step: int, timeout: "float | timedelta"
     ) -> T:
         """Fetch the state for ``step`` from ``src_rank``."""
+
+    # Pull-based transports that can fetch the same step from any up-to-date
+    # peer set this True and implement recv_checkpoint_multi; push-based
+    # transports (PGTransport: only the assigned source is sending) cannot
+    # fail over without sender-side coordination and must keep it False so
+    # the Manager never blocks on a fallback peer that will never send.
+    supports_multi_source: bool = False
+
+    def recv_checkpoint_multi(
+        self,
+        sources: List[Tuple[str, Callable[[], str]]],
+        step: int,
+        timeout: "float | timedelta",
+        on_event: Optional[Callable[..., None]] = None,
+    ) -> T:
+        """Fetch the state for ``step`` from an ordered list of candidate
+        sources, failing over mid-transfer when one dies.
+
+        ``sources`` is ``[(label, metadata_fn), ...]`` — ``metadata_fn``
+        resolves the peer's transport metadata lazily (typically a
+        ``_checkpoint_metadata`` RPC) so an unreachable fallback costs
+        nothing unless it is actually tried. ``on_event(kind, **fields)``
+        receives ``heal_retry`` / ``heal_failover`` / ``chunk_crc_failure``
+        notifications as they happen."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support multi-source receive"
+        )
 
     def last_recv_timings(self) -> Optional[StreamTimings]:
         """Chunk-stream stats of the most recent ``recv_checkpoint`` (None

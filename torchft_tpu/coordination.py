@@ -18,12 +18,15 @@ import os
 import subprocess
 from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .retry import RetryPolicy, retry_call
 
 __all__ = [
     "QuorumMember",
     "Quorum",
     "QuorumResult",
+    "FallbackPeer",
     "LighthouseServer",
     "LighthouseClient",
     "ManagerServer",
@@ -207,6 +210,21 @@ class Quorum:
 
 
 @dataclass
+class FallbackPeer:
+    """An up-to-date peer a healing replica can fail over to if its assigned
+    recovery source dies mid-transfer."""
+
+    replica_rank: int
+    address: str  # manager RPC address (host:port)
+
+    @staticmethod
+    def _from_json(d: dict) -> "FallbackPeer":
+        return FallbackPeer(
+            replica_rank=d.get("replica_rank", 0), address=d.get("address", "")
+        )
+
+
+@dataclass
 class QuorumResult:
     """Per-rank manager quorum response (reference: proto ManagerQuorumResponse
     + src/lib.rs:284-319)."""
@@ -224,6 +242,9 @@ class QuorumResult:
     heal: bool
     commit_failures: int = 0
     replica_ids: List[str] = field(default_factory=list)
+    # remaining max_step peers in round-robin order after the assigned
+    # source; empty when not healing or from a pre-fallback native build
+    recover_src_fallbacks: List[FallbackPeer] = field(default_factory=list)
 
     @staticmethod
     def _from_json(d: dict) -> "QuorumResult":
@@ -241,6 +262,10 @@ class QuorumResult:
             heal=d.get("heal", False),
             commit_failures=d.get("commit_failures", 0),
             replica_ids=list(d.get("replica_ids", [])),
+            recover_src_fallbacks=[
+                FallbackPeer._from_json(f)
+                for f in d.get("recover_src_fallbacks", [])
+            ],
         )
 
 
@@ -389,10 +414,51 @@ class KvStoreServer:
 
 
 # ------------------------------------------------------------------- clients
-class _RawClient:
-    """Generic framed-JSON RPC client over the native transport."""
+# Test-only fault injection: called before every RPC attempt with
+# (method, addr); may sleep (to model a slow link) and/or return an exception
+# to raise in place of the real call (to model a flaky/partitioned server).
+# Lets tests exercise the retry paths deterministically without real outages.
+_rpc_fault_hook: Optional[Callable[[str, str], Optional[Exception]]] = None
 
-    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
+
+def set_rpc_fault_hook(
+    hook: Optional[Callable[[str, str], Optional[Exception]]],
+) -> None:
+    """Install (or clear, with None) the process-wide RPC fault hook."""
+    global _rpc_fault_hook
+    _rpc_fault_hook = hook
+
+
+# Exceptions worth retrying: connection-class failures (_UNAVAILABLE/_ERROR
+# map to RuntimeError, stalls to TimeoutError). _NOT_FOUND/_INVALID are
+# semantic errors — retrying cannot change the answer.
+_RETRYABLE_RPC_ERRORS = (TimeoutError, RuntimeError, ConnectionError)
+
+
+def _seconds(timeout: "float | timedelta") -> float:
+    if isinstance(timeout, timedelta):
+        return timeout.total_seconds()
+    return float(timeout)
+
+
+class _RawClient:
+    """Generic framed-JSON RPC client over the native transport.
+
+    Every call runs under the shared jittered-backoff retry policy
+    (``TORCHFT_RETRY_*`` env knobs; ``TORCHFT_RETRY_MAX_ATTEMPTS=1``
+    disables) with the caller's timeout as the hard deadline budget — the
+    native ``RpcClient`` re-dials a stale cached connection per attempt, so
+    a server blip shorter than the budget degrades to a slower call rather
+    than an errored one. On exhaustion the *last underlying* exception is
+    re-raised so callers keep their exact pre-retry exception taxonomy.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        connect_timeout: "float | timedelta" = 10.0,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
         self._lib = _load()
         handle = ctypes.c_void_p()
         err = ctypes.c_char_p()
@@ -403,16 +469,29 @@ class _RawClient:
         _raise_for_status(status, _take_str(self._lib, err), "client create failed")
         self._handle = handle
         self.addr = addr
+        self._retry_policy = (
+            retry_policy if retry_policy is not None else RetryPolicy.from_env()
+        )
+        # observer: (method, attempt, prior_exception) on every retry attempt
+        self.on_retry: Optional[Callable[[str, int, BaseException], None]] = None
 
-    def call(self, method: str, params: dict, timeout: "float | timedelta") -> dict:
-        return self.call_raw(method, json.dumps(params).encode(), timeout)
+    def call(
+        self,
+        method: str,
+        params: dict,
+        timeout: "float | timedelta",
+        retry: bool = True,
+    ) -> dict:
+        return self.call_raw(method, json.dumps(params).encode(), timeout, retry)
 
-    def call_raw(
+    def _call_once(
         self, method: str, params_json: bytes, timeout: "float | timedelta"
     ) -> dict:
-        """Like :meth:`call` but takes the params frame pre-encoded —
-        per-step callers (the commit vote) build their frame once and
-        splice in what changes, skipping json.dumps on the hot path."""
+        hook = _rpc_fault_hook
+        if hook is not None:
+            injected = hook(method, self.addr)
+            if injected is not None:
+                raise injected
         result = ctypes.c_char_p()
         err = ctypes.c_char_p()
         status = self._lib.tft_client_call(
@@ -423,6 +502,43 @@ class _RawClient:
         result_s = _take_str(self._lib, result)
         _raise_for_status(status, err_s, f"{method} to {self.addr} failed")
         return json.loads(result_s) if result_s else {}
+
+    def call_raw(
+        self,
+        method: str,
+        params_json: bytes,
+        timeout: "float | timedelta",
+        retry: bool = True,
+    ) -> dict:
+        """Like :meth:`call` but takes the params frame pre-encoded —
+        per-step callers (the commit vote) build their frame once and
+        splice in what changes, skipping json.dumps on the hot path.
+
+        ``retry=False`` opts a call out of the retry policy — required for
+        non-idempotent RPCs (``add``) and fire-and-forget ones (``kill``)."""
+        policy = self._retry_policy
+        if not retry or not policy.enabled:
+            return self._call_once(method, params_json, timeout)
+
+        def _on_attempt(attempt: int, prior: Optional[BaseException]) -> None:
+            if attempt > 1 and prior is not None and self.on_retry is not None:
+                self.on_retry(method, attempt, prior)
+
+        from .retry import RetryBudgetExhausted
+
+        try:
+            return retry_call(
+                lambda remaining: self._call_once(method, params_json, remaining),
+                policy,
+                timeout=_seconds(timeout),
+                retryable=_RETRYABLE_RPC_ERRORS,
+                on_attempt=_on_attempt,
+            )
+        except RetryBudgetExhausted as e:
+            # preserve the pre-retry exception taxonomy for callers
+            # (RuntimeError stays RuntimeError, TimeoutError TimeoutError)
+            assert e.last_exception is not None
+            raise e.last_exception from e
 
     def __del__(self) -> None:
         try:
@@ -436,8 +552,20 @@ class _RawClient:
 class LighthouseClient:
     """Client for the lighthouse service (reference: src/lib.rs:486-594)."""
 
-    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
-        self._client = _RawClient(addr, connect_timeout)
+    def __init__(
+        self,
+        addr: str,
+        connect_timeout: "float | timedelta" = 10.0,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
+        self._client = _RawClient(addr, connect_timeout, retry_policy)
+
+    def set_retry_observer(
+        self, fn: Optional[Callable[[str, int, BaseException], None]]
+    ) -> None:
+        """Observer called as ``fn(method, attempt, prior_exc)`` on each RPC
+        retry attempt (never on the first attempt)."""
+        self._client.on_retry = fn
 
     def quorum(
         self,
@@ -474,13 +602,25 @@ class LighthouseClient:
 class ManagerClient:
     """Client for a replica group's manager service (reference: src/lib.rs:153-282)."""
 
-    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
-        self._client = _RawClient(addr, connect_timeout)
+    def __init__(
+        self,
+        addr: str,
+        connect_timeout: "float | timedelta" = 10.0,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
+        self._client = _RawClient(addr, connect_timeout, retry_policy)
         # pre-built vote frames keyed by (group_rank, vote): everything but
         # the step number is invariant across a training run, so the
         # per-step should_commit only splices the step into a cached prefix
         # instead of re-serializing the params dict (see should_commit)
         self._vote_frames: Dict[Tuple[int, bool], bytes] = {}
+
+    def set_retry_observer(
+        self, fn: Optional[Callable[[str, int, BaseException], None]]
+    ) -> None:
+        """Observer called as ``fn(method, attempt, prior_exc)`` on each RPC
+        retry attempt (never on the first attempt)."""
+        self._client.on_retry = fn
 
     def _quorum(
         self,
@@ -534,7 +674,8 @@ class ManagerClient:
 
     def kill(self, msg: str = "", timeout: "float | timedelta" = 5.0) -> None:
         try:
-            self._client.call("kill", {"msg": msg}, timeout)
+            # fire-and-forget: never retried (the target exits mid-reply)
+            self._client.call("kill", {"msg": msg}, timeout, retry=False)
         except (RuntimeError, TimeoutError):
             pass  # the target exits without replying
 
@@ -547,8 +688,18 @@ class KvClient:
     handles both transparently.
     """
 
-    def __init__(self, addr: str, connect_timeout: "float | timedelta" = 10.0):
-        self._client = _RawClient(addr, connect_timeout)
+    def __init__(
+        self,
+        addr: str,
+        connect_timeout: "float | timedelta" = 10.0,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
+        self._client = _RawClient(addr, connect_timeout, retry_policy)
+
+    def set_retry_observer(
+        self, fn: Optional[Callable[[str, int, BaseException], None]]
+    ) -> None:
+        self._client.on_retry = fn
 
     def set(self, key: str, value: "bytes | str", timeout: "float | timedelta" = 10.0) -> None:
         import base64
@@ -573,9 +724,10 @@ class KvClient:
         return value.encode()  # add() counter or other plain-text value
 
     def add(self, key: str, amount: int, timeout: "float | timedelta" = 10.0) -> int:
-        return self._client.call("add", {"key": key, "amount": amount}, timeout)[
-            "value"
-        ]
+        # non-idempotent: a retry after a lost reply would double-count
+        return self._client.call(
+            "add", {"key": key, "amount": amount}, timeout, retry=False
+        )["value"]
 
     def check(self, keys: List[str], timeout: "float | timedelta" = 10.0) -> bool:
         return self._client.call("check", {"keys": keys}, timeout)["exists"]

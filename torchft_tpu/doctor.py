@@ -6,9 +6,13 @@ group right now": native control plane builds and serves, JAX backend
 initializes (with a subprocess probe so a wedged TPU tunnel reports as
 WEDGED instead of hanging the doctor — the failure mode bench.py's
 `_probe_accelerator` exists for), the virtual multi-device CPU mesh works
-(what tests and dryruns rely on), a lighthouse round-trip completes, and
-a loopback live-heal round-trip through the default HTTP transport lands
-in place (the tier-1 recovery path a rejoining replica depends on).
+(what tests and dryruns rely on), a lighthouse round-trip completes, the
+``TORCHFT_RETRY_*`` env knobs are sane (parseable, and the worst-case
+backoff budget ordered below the quorum timeout), and a loopback
+live-heal round-trip through the default HTTP transport lands in place —
+with one mid-transfer connection drop injected so the ranged-resume path
+(the tier-1 recovery behavior a rejoining replica depends on) is
+exercised, not just the happy path.
 
 Exit code 0 iff every check passes (the accelerator check passes as
 "cpu-only" — a legitimate dev box). Prints one line per check:
@@ -105,14 +109,61 @@ def check_lighthouse_roundtrip() -> Result:
         return False, f"lighthouse round-trip failed: {e}"
 
 
+def check_retry_env() -> Result:
+    """TORCHFT_RETRY_* env sanity: the values parse, and the worst-case
+    retry sleep budget is ordered BELOW the quorum timeout — a backoff
+    schedule that can out-sleep the quorum window turns every control-plane
+    blip into a quorum failure instead of a slower step."""
+    try:
+        from torchft_tpu.retry import RetryPolicy
+
+        policy = RetryPolicy.from_env()
+    except ValueError as e:
+        return False, f"TORCHFT_RETRY_* env invalid: {e}"
+    quorum_timeout_s = float(
+        os.environ.get(
+            "TORCHFT_QUORUM_TIMEOUT_SEC",
+            os.environ.get("TORCHFT_TIMEOUT_SEC", "60.0"),
+        )
+    )
+    # worst case: every sleep hits the ceiling, jitter draws nothing
+    worst_sleep_s = sum(
+        policy.backoff_s(attempt) for attempt in range(2, policy.max_attempts + 1)
+    )
+    detail = (
+        f"attempts={policy.max_attempts} base={policy.base_s}s "
+        f"ceiling={policy.max_backoff_s}s jitter={policy.jitter} "
+        f"(worst sleep {worst_sleep_s:.2f}s vs quorum {quorum_timeout_s:.0f}s)"
+    )
+    if policy.max_backoff_s >= quorum_timeout_s:
+        return False, (
+            f"backoff ceiling {policy.max_backoff_s}s >= quorum timeout "
+            f"{quorum_timeout_s}s — one retry sleep can eat the whole "
+            "quorum window; lower TORCHFT_RETRY_MAX_BACKOFF_S"
+        )
+    if worst_sleep_s >= quorum_timeout_s:
+        return None, (
+            f"worst-case retry sleep {worst_sleep_s:.2f}s >= quorum "
+            f"timeout {quorum_timeout_s}s — retries may burn the quorum "
+            "window sleeping; lower TORCHFT_RETRY_MAX_ATTEMPTS or the "
+            "backoff knobs"
+        )
+    if not policy.enabled:
+        return None, f"retries disabled (max_attempts=1); {detail}"
+    return True, detail
+
+
 def check_heal_roundtrip() -> Result:
     """Loopback live-heal: send a small composite through the default
     HTTPTransport and receive it in place — the tier-1 recovery path a
-    rejoining replica depends on."""
+    rejoining replica depends on. The serve of chunk 1 is armed to drop
+    mid-transfer once, so the check also exercises one ranged re-fetch:
+    the receiver must resume from its last verified byte, not restart."""
     try:
         import numpy as np
 
         from torchft_tpu.checkpointing import HTTPTransport
+        from torchft_tpu.retry import RetryPolicy
 
         state = {"user": {"w": np.arange(256, dtype=np.float32)},
                  "torchft": {"step": 3, "batches_committed": 6}}
@@ -123,11 +174,21 @@ def check_heal_roundtrip() -> Result:
         # and this check diagnoses the transport, not DNS
         send = HTTPTransport(timeout=10.0, num_chunks=2,
                              hostname="127.0.0.1")
+        # explicit policy: the check must re-fetch deterministically even
+        # when the operator's env disables retries (that env shape is
+        # check_retry_env's job to flag, not this one's to inherit)
         recv = HTTPTransport(timeout=10.0,
-                             state_dict_template=lambda: template)
+                             state_dict_template=lambda: template,
+                             retry_policy=RetryPolicy(
+                                 max_attempts=3, base_s=0.01, jitter=0.0))
+        events: list = []
         try:
             send.send_checkpoint([1], 3, state, 10.0)
-            got = recv.recv_checkpoint(0, send.metadata(), 3, 10.0)
+            send.inject_chunk_fault(1, "die", times=1)
+            got = recv.recv_checkpoint_multi(
+                [("loopback", send.metadata)], 3, 10.0,
+                on_event=lambda kind, **f: events.append((kind, f)),
+            )
         finally:
             send.shutdown()
             recv.shutdown()
@@ -135,7 +196,19 @@ def check_heal_roundtrip() -> Result:
             return False, "heal received but not in place (template unused)"
         if not np.array_equal(got["user"]["w"], state["user"]["w"]):
             return False, "heal payload mismatch"
-        return True, "http heal round-trip in place (1 KiB composite)"
+        resumed = [
+            f for kind, f in events
+            if kind == "heal_retry" and f.get("resume_offset", 0) > 0
+        ]
+        if not resumed:
+            return False, (
+                "mid-transfer drop never produced a ranged resume "
+                f"(events: {[k for k, _ in events]})"
+            )
+        return True, (
+            "http heal round-trip in place; ranged re-fetch resumed at "
+            f"byte {resumed[0]['resume_offset']}"
+        )
     except Exception as e:  # noqa: BLE001
         return False, f"heal round-trip failed: {e}"
 
@@ -145,6 +218,7 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("accelerator", check_accelerator),
     ("virtual-mesh", check_virtual_mesh),
     ("lighthouse", check_lighthouse_roundtrip),
+    ("retry-env", check_retry_env),
     ("heal", check_heal_roundtrip),
 ]
 

@@ -18,6 +18,13 @@ replica's coordinates rather than reading mutable singleton state, and
 events carry whatever identifying fields the recording site passes.
 
 Thread-safe; recording is O(1) append of already-built dicts, no I/O.
+
+Resilient-heal instrumentation rides the same ring: the Manager records
+``heal_retry`` / ``heal_failover`` / ``chunk_crc_failure`` as the
+checkpoint transport reports them and ``rpc_retry`` per retried
+control-plane call, and dumps with ``reason="heal_exhausted"`` when a heal
+runs out of candidate peers — so the dump contains the full retry/failover
+sequence that led to the abort.
 """
 
 from __future__ import annotations

@@ -346,6 +346,11 @@ class Manager:
         }
         self._healing = False
         self._last_quorum_healed = False
+        # True while this replica holds a standby failover snapshot open
+        # for a heal in progress elsewhere in the quorum (see
+        # _async_quorum_body); should_commit defers disallow_checkpoint
+        # until the episode ends
+        self._standby_source = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         # prepare/commit configure split: the quorum thread stages the
         # reconfigure (prepare_configure) and stashes the returned commit
@@ -358,6 +363,22 @@ class Manager:
         # (quorum_overlap_s, configure_prepare_s, configure_commit_s,
         # heal_recv_s, ...) — shares _metrics_lock
         self._timings: Dict[str, float] = {}
+        # resilience counters ride the same dict so they flow through
+        # timings() and the torchft_timings stream without a second
+        # plumbing path. Unlike the phase timings these are CUMULATIVE:
+        # a blip that cost two RPC retries three steps ago stays visible.
+        for _counter in (
+            "heal_attempts",
+            "heal_failovers",
+            "rpc_retries",
+            "chunk_crc_failures",
+        ):
+            self._timings[_counter] = 0.0
+        # rpc_retries: every retried control-plane call on either manager
+        # client bumps the counter and leaves a flight-recorder breadcrumb,
+        # so "the step got slower" is attributable to a named RPC.
+        self._client.set_retry_observer(self._on_rpc_retry)
+        self._vote_client.set_retry_observer(self._on_rpc_retry)
         self._participating_replica_rank: Optional[int] = None
         # last seen PG backend generation (see _sync_device_world)
         self._device_world_epoch = getattr(pg, "device_world_epoch", None)
@@ -673,27 +694,44 @@ class Manager:
                         "heal_send_s", time.perf_counter() - t_send
                     )
 
+                # Standby failover source: someone in the quorum is behind
+                # but WE got no dst assignment. A healing replica whose
+                # assigned source dies mid-transfer fails over to the
+                # fallback peers the quorum computed — which only works if
+                # those peers actually have the step staged. Stage once per
+                # heal episode (rising edge; the snapshot owns host copies,
+                # so serving stays consistent while training mutates live
+                # state) and hold the window open across commits until the
+                # quorum shows nobody behind (should_commit skips
+                # disallow_checkpoint while _standby_source is set).
+                # Pull-based transports only: a PGTransport standby would
+                # just rendezvous a transfer no one initiates.
+                standby = (
+                    not quorum.heal
+                    and not quorum.recover_dst_replica_ranks
+                    and quorum.max_world_size < quorum.replica_world_size
+                    and self._checkpoint_transport.supports_multi_source
+                )
+                if standby and not self._standby_source:
+                    self._logger.info(
+                        "staging standby failover snapshot for "
+                        f"step {quorum.max_step}"
+                    )
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=[],
+                        step=quorum.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
+                self._standby_source = standby
+
                 if quorum.heal:
                     self._healing = True
-                    self._logger.info(
-                        f"healing required, fetching metadata from {quorum.recover_src_manager_address}"
-                    )
-                    primary_client = ManagerClient(
-                        quorum.recover_src_manager_address,
-                        connect_timeout=self._connect_timeout,
-                    )
-                    checkpoint_metadata = primary_client._checkpoint_metadata(
-                        self._group_rank, timeout=self._timeout
-                    )
                     assert quorum.recover_src_replica_rank is not None
+                    self._bump_counter("heal_attempts")
                     t_recv = time.perf_counter()
                     with trace_span("torchft::manager::recv_checkpoint"):
-                        self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
-                            src_rank=quorum.recover_src_replica_rank,
-                            metadata=checkpoint_metadata,
-                            step=quorum.max_step,
-                            timeout=self._timeout,
-                        )
+                        self._pending_state_dict = self._recv_checkpoint(quorum)
                     self._record_timing(
                         "heal_recv_s", time.perf_counter() - t_recv
                     )
@@ -708,6 +746,120 @@ class Manager:
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in recovery: {e}")
                 self.report_error(e)
+
+    # ------------------------------------------------------------- healing
+    def _heal_sources(
+        self, quorum: Any
+    ) -> List[Any]:
+        """Ordered candidate sources for a multi-peer heal: the assigned
+        recovery source first, then every other up-to-date peer in the
+        round-robin order the native quorum computed
+        (``recover_src_fallbacks``). Each entry is ``(label, metadata_fn)``
+        with the metadata RPC resolved LAZILY — an unreachable fallback
+        costs nothing unless the transport actually fails over to it."""
+
+        def _metadata_fn(addr: str) -> Callable[[], str]:
+            def fetch() -> str:
+                client = ManagerClient(
+                    addr, connect_timeout=self._connect_timeout
+                )
+                # the metadata RPC itself rides the bounded-retry layer,
+                # feeding the same rpc_retries counter as the main clients
+                client.set_retry_observer(self._on_rpc_retry)
+                return client._checkpoint_metadata(
+                    self._group_rank, timeout=self._timeout
+                )
+
+            return fetch
+
+        sources = [
+            (
+                f"replica_rank_{quorum.recover_src_replica_rank}"
+                f"@{quorum.recover_src_manager_address}",
+                _metadata_fn(quorum.recover_src_manager_address),
+            )
+        ]
+        for peer in quorum.recover_src_fallbacks:
+            sources.append(
+                (
+                    f"replica_rank_{peer.replica_rank}@{peer.address}",
+                    _metadata_fn(peer.address),
+                )
+            )
+        return sources
+
+    def _on_heal_event(self, kind: str, **fields: Any) -> None:
+        """Transport → Manager bridge for resilient-heal notifications:
+        bump the matching cumulative counter and leave a flight-recorder
+        breadcrumb so a postmortem can reconstruct the heal's retry/
+        failover sequence."""
+        counter = {
+            "heal_retry": "heal_attempts",
+            "heal_failover": "heal_failovers",
+            "chunk_crc_failure": "chunk_crc_failures",
+        }.get(kind)
+        if counter is not None:
+            self._bump_counter(counter)
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            kind,
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+            **fields,
+        )
+
+    def _recv_checkpoint(self, quorum: Any) -> Dict[str, Any]:
+        """Fetch the healing checkpoint, failing over across up-to-date
+        peers when the transport supports it (pull-based HTTP). Push-based
+        transports (PGTransport) stay on the single assigned source — a
+        fallback peer there would never send, so failing over to it could
+        only hang (see ``CheckpointTransport.supports_multi_source``)."""
+        transport = self._checkpoint_transport
+        if transport.supports_multi_source:
+            sources = self._heal_sources(quorum)
+            self._logger.info(
+                f"healing required, {len(sources)} candidate source(s): "
+                f"{[label for label, _ in sources]}"
+            )
+            try:
+                return transport.recv_checkpoint_multi(
+                    sources,
+                    step=quorum.max_step,
+                    timeout=self._timeout,
+                    on_event=self._on_heal_event,
+                )
+            except Exception:
+                # every candidate peer exhausted within the heal budget:
+                # dump the ring buffer NOW, while the heal_retry/
+                # heal_failover breadcrumbs are still in it
+                from torchft_tpu.flight_recorder import recorder
+
+                recorder.dump(
+                    reason="heal_exhausted",
+                    quorum_id=quorum.quorum_id,
+                    tag=f"{self._replica_id}_{self._group_rank}",
+                )
+                raise
+        self._logger.info(
+            f"healing required, fetching metadata from "
+            f"{quorum.recover_src_manager_address}"
+        )
+        primary_client = ManagerClient(
+            quorum.recover_src_manager_address,
+            connect_timeout=self._connect_timeout,
+        )
+        primary_client.set_retry_observer(self._on_rpc_retry)
+        checkpoint_metadata = primary_client._checkpoint_metadata(
+            self._group_rank, timeout=self._timeout
+        )
+        return transport.recv_checkpoint(
+            src_rank=quorum.recover_src_replica_rank,
+            metadata=checkpoint_metadata,
+            step=quorum.max_step,
+            timeout=self._timeout,
+        )
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"
@@ -1466,6 +1618,31 @@ class Manager:
         with self._metrics_lock:
             self._timings[name] = value
 
+    def _bump_counter(self, name: str, n: float = 1.0) -> None:
+        """Increment a cumulative resilience counter in timings()."""
+        with self._metrics_lock:
+            self._timings[name] = self._timings.get(name, 0.0) + n
+
+    def _on_rpc_retry(self, method: str, attempt: int, exc: BaseException) -> None:
+        """Retry observer installed on both manager RPC clients: a
+        control-plane blip shorter than the quorum timeout degrades to a
+        slower step, and this is the audit trail that says so."""
+        self._bump_counter("rpc_retries")
+        self._logger.warning(
+            f"RPC {method} retrying (attempt {attempt}) after {exc!r}"
+        )
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            "rpc_retry",
+            method=method,
+            attempt=attempt,
+            error=repr(exc),
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+        )
+
     def _record_pipeline_timings(self, marks: List[Dict[str, Any]]) -> None:
         """Fold one streamed allreduce's per-bucket stage marks into
         timings(): summed ``allreduce_pack_s`` / ``allreduce_wire_s`` /
@@ -1492,7 +1669,14 @@ class Manager:
         ``allreduce_pack_s`` / ``allreduce_wire_s`` / ``allreduce_unpack_s``
         / ``allreduce_buckets`` / ``overlap_efficiency`` (see
         :meth:`_record_pipeline_timings`). Keys appear once the phase has
-        run."""
+        run.
+
+        Also carries the CUMULATIVE resilience counters (present from
+        construction, never reset): ``heal_attempts`` (initial heal tries
+        plus same-source retries), ``heal_failovers`` (mid-heal switches to
+        a fallback peer), ``rpc_retries`` (retried control-plane calls),
+        and ``chunk_crc_failures`` (chunks refetched after an integrity
+        mismatch)."""
         with self._metrics_lock:
             return dict(self._timings)
 
@@ -1665,7 +1849,8 @@ class Manager:
             num_participants=self.num_participants(),
         )
 
-        self._checkpoint_transport.disallow_checkpoint()
+        if not self._standby_source:
+            self._checkpoint_transport.disallow_checkpoint()
 
         if should_commit:
             self._step += 1

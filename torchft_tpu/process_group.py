@@ -36,6 +36,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from datetime import timedelta
@@ -1595,14 +1596,21 @@ class ErrorSwallowingProcessGroupWrapper(ProcessGroup):
 
 class FakeProcessGroupWrapper(ProcessGroup):
     """Test-only fault injection: ``report_future_error`` makes the next
-    op's future raise (reference: process_group.py:1252-1317)."""
+    op's future raise (reference: process_group.py:1252-1317), and the
+    network-shaped knobs (``times`` for a flaky-link burst, ``delay_ops``
+    for a stalled-but-alive wire) let tests reproduce degraded transports
+    rather than only clean crashes."""
 
     def __init__(self, pg: ProcessGroup) -> None:
         super().__init__()
         self._pg = pg
         self._next_error: Optional[Exception] = None
         self._next_error_skip = 0
+        self._next_error_times = 0
         self._next_configure_error: Optional[Exception] = None
+        # network-stall shape: the next N ops sleep before dispatch
+        self._delay_ops_s = 0.0
+        self._delay_ops_count = 0
         # test hook: called at the START of prepare_configure (on the
         # quorum thread) — EventInjector uses it to stall the prepare
         # phase past a step boundary deterministically
@@ -1612,13 +1620,24 @@ class FakeProcessGroupWrapper(ProcessGroup):
     def device_native(self) -> bool:
         return getattr(self._pg, "device_native", False)
 
-    def report_future_error(self, e: Exception, skip_ops: int = 0) -> None:
-        """Fail one upcoming op's future with ``e``. ``skip_ops=k`` lets the
+    def report_future_error(
+        self, e: Exception, skip_ops: int = 0, times: int = 1
+    ) -> None:
+        """Fail upcoming ops' futures with ``e``. ``skip_ops=k`` lets the
         next k ops through untouched and fails the (k+1)-th — with the
         per-bucket streaming pipeline, that targets bucket k of a plan
-        mid-stream instead of only ever the first collective."""
+        mid-stream instead of only ever the first collective. ``times=n``
+        fails n consecutive ops (a flaky link rather than a single drop)."""
         self._next_error = e
         self._next_error_skip = int(skip_ops)
+        self._next_error_times = max(1, int(times))
+
+    def delay_ops(self, seconds: float, count: int = 1) -> None:
+        """Stall the next ``count`` ops by ``seconds`` before their work
+        handle is returned — a slow-but-alive wire, the shape that
+        exercises timeout/retry budgets without tripping the error path."""
+        self._delay_ops_s = float(seconds)
+        self._delay_ops_count = int(count)
 
     def report_configure_error(self, e: Exception) -> None:
         self._next_configure_error = e
@@ -1666,11 +1685,17 @@ class FakeProcessGroupWrapper(ProcessGroup):
         self._pg.set_timeout(timeout)
 
     def _maybe_fail(self, work: Work) -> Work:
+        if self._delay_ops_count > 0:
+            self._delay_ops_count -= 1
+            time.sleep(self._delay_ops_s)
         if self._next_error is not None:
             if self._next_error_skip > 0:
                 self._next_error_skip -= 1
                 return work
-            e, self._next_error = self._next_error, None
+            e = self._next_error
+            self._next_error_times -= 1
+            if self._next_error_times <= 0:
+                self._next_error = None
             fut: Future[Any] = Future()
 
             def _fail(_f: Future[Any]) -> None:

@@ -248,6 +248,42 @@ def ft_overhead_metrics(steps: int = 30, warmup: int = 5,
     )
 
 
+def healthwatch_metrics(steps: int = 30, warmup: int = 5,
+                        batch_size: int = 8) -> dict:
+    """Healthwatch steady-state cost + /health under load: the example
+    trainer under a Manager whose lighthouse runs the health ledger, with
+    poller threads hammering the /health endpoint the whole time, then the
+    per-step publish+fold path micro-timed directly. CPU-pinned subprocess,
+    same isolation policy as the other FT rows."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    child = (
+        "from torchft_tpu.utils import force_virtual_cpu_devices\n"
+        "force_virtual_cpu_devices(1)\n"
+        "import sys, json\n"
+        f"sys.path.insert(0, {os.path.join(os.path.dirname(os.path.abspath(__file__)), 'benchmarks')!r})\n"
+        "from healthwatch_bench import run\n"
+        f"print('HEALTHWATCH ' + json.dumps(run(steps={steps}, "
+        f"warmup={warmup}, batch_size={batch_size})))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=300,
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("HEALTHWATCH "):
+            return _json.loads(line[len("HEALTHWATCH "):])
+    raise RuntimeError(
+        f"healthwatch child failed rc={out.returncode}: "
+        f"{(out.stderr or out.stdout)[-300:]}"
+    )
+
+
 def allreduce_pipeline_metrics(size_mb: float = 64, leaves: int = 16,
                                cap_mb: float = 4, steps: int = 10,
                                warmup: int = 3) -> dict:
@@ -364,6 +400,54 @@ def ft_overhead(smoke: bool = False) -> None:
     print(json.dumps({
         "metric": "ft steady-state overhead (example trainer, host plane)",
         "value": metrics["ft_overhead_pct"],
+        "unit": "%",
+        "vs_baseline": 1,
+        **metrics,
+    }))
+
+
+def healthwatch(smoke: bool = False) -> None:
+    """``python bench.py --healthwatch [--smoke]``: one JSON line with
+    ``healthwatch_overhead_pct`` (per-step telemetry publish + health fold
+    as a share of the managed step) and the /health-under-load tallies.
+    The gates hold the subsystem's two promises: the telemetry plane costs
+    under 1% of a step, and the /health endpoint answers every poll while
+    training is live."""
+    if smoke:
+        metrics = healthwatch_metrics(steps=8, warmup=2)
+    else:
+        metrics = healthwatch_metrics()
+    required = [
+        "healthwatch_overhead_pct",
+        "healthwatch_publish_s",
+        "health_polls_ok",
+        "health_polls_failed",
+        "health_replicas_tracked",
+    ]
+    missing = [k for k in required if metrics.get(k) is None]
+    if missing:
+        raise RuntimeError(f"healthwatch: missing keys: {missing}")
+    if not metrics["healthwatch_overhead_pct"] < 1.0:
+        raise RuntimeError(
+            f"healthwatch: overhead {metrics['healthwatch_overhead_pct']}% "
+            ">= 1% of the managed step — the telemetry publish or health "
+            "fold grew a real cost"
+        )
+    if not metrics["health_polls_ok"] > 0:
+        raise RuntimeError("healthwatch: no successful /health polls")
+    if metrics["health_polls_failed"] != 0:
+        raise RuntimeError(
+            f"healthwatch: {metrics['health_polls_failed']} /health polls "
+            f"failed under load: {metrics.get('health_poll_first_error')}"
+        )
+    if not metrics["health_replicas_tracked"] >= 1:
+        raise RuntimeError(
+            "healthwatch: the ledger never tracked the benched replica — "
+            "telemetry is not reaching the lighthouse"
+        )
+    print(json.dumps({
+        "metric": "healthwatch steady-state cost (example trainer)",
+        "value": metrics["healthwatch_overhead_pct"],
         "unit": "%",
         "vs_baseline": 1,
         **metrics,
@@ -574,6 +658,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["arpipe_error"] = str(e)[:200]
 
+    # healthwatch steady-state cost + /health under load (best-effort,
+    # same policy: never costs the headline)
+    try:
+        record.update(healthwatch_metrics())
+    except Exception as e:  # noqa: BLE001
+        record["healthwatch_error"] = str(e)[:200]
+
     print(json.dumps(record))
 
 
@@ -621,6 +712,10 @@ if __name__ == "__main__":
     if "--allreduce-pipeline" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         allreduce_pipeline(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--healthwatch" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        healthwatch(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--smoke" in sys.argv[1:]:
         # no always-emit wrapper here: the smoke gate must fail loudly

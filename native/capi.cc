@@ -10,6 +10,7 @@
 #include <cstring>
 #include <string>
 
+#include "healthwatch.h"
 #include "kvstore.h"
 #include "lighthouse.h"
 #include "manager_server.h"
@@ -75,6 +76,30 @@ int tft_lighthouse_new(const char* bind, int64_t min_replicas,
   })
 }
 
+// JSON-opts constructor (supersedes the scalar one above, which is kept for
+// ABI compat): {"bind": ..., "min_replicas": N, "join_timeout_ms": N,
+// "quorum_tick_ms": N, "heartbeat_timeout_ms": N, "health": {...}} — the
+// "health" object is HealthOpts (healthwatch.h), absent -> defaults
+// (observe mode).
+int tft_lighthouse_new_v2(const char* opts_json, void** out, char** err) {
+  TFT_TRY({
+    Json j = Json::parse(opts_json);
+    LighthouseOpts opts;
+    std::string bind = j.get_or("bind", Json("0.0.0.0:0")).as_string();
+    opts.min_replicas = j.get_or("min_replicas", Json(int64_t{1})).as_int();
+    opts.join_timeout_ms =
+        j.get_or("join_timeout_ms", Json(int64_t{60000})).as_int();
+    opts.quorum_tick_ms =
+        j.get_or("quorum_tick_ms", Json(int64_t{100})).as_int();
+    opts.heartbeat_timeout_ms =
+        j.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
+    HealthOpts health =
+        HealthOpts::from_json(j.get_or("health", Json::object()));
+    *out = new Lighthouse(bind, opts, health);
+    return TFT_OK;
+  })
+}
+
 char* tft_lighthouse_address(void* h) {
   return dup_str(static_cast<Lighthouse*>(h)->address());
 }
@@ -103,6 +128,18 @@ int tft_manager_new(const char* opts_json, void** out, char** err) {
     *out = new ManagerServer(opts);
     return TFT_OK;
   })
+}
+
+int tft_manager_publish_telemetry(void* h, const char* telemetry_json,
+                                  char** err) {
+  TFT_TRY({
+    static_cast<ManagerServer*>(h)->publish_telemetry(telemetry_json);
+    return TFT_OK;
+  })
+}
+
+char* tft_manager_health(void* h) {
+  return dup_str(static_cast<ManagerServer*>(h)->health_json());
 }
 
 char* tft_manager_address(void* h) {
@@ -195,6 +232,10 @@ int tft_quorum_compute(const char* state_json, const char* opts_json,
     }
     if (js.contains("prev_quorum") && !js.get("prev_quorum").is_null())
       state.prev_quorum = QuorumSnapshot::from_json(js.get("prev_quorum"));
+    if (js.contains("excluded")) {
+      for (const auto& rid : js.get("excluded").as_array())
+        state.excluded.insert(rid.as_string());
+    }
 
     auto [met, reason] = quorum_compute(now, state, opts);
     Json out = Json::object();
@@ -206,6 +247,84 @@ int tft_quorum_compute(const char* state_json, const char* opts_json,
     } else {
       out["participants"] = Json();
     }
+    if (result) *result = dup_str(out.dump());
+    return TFT_OK;
+  })
+}
+
+// ------------------------------------------------------- pure health logic
+// Parity hooks for tests: torchft_tpu/healthwatch.py carries the canonical
+// Python scoring/policy spec, and tests drive the SAME synthetic inputs
+// through these to pin the native ledger to it.
+
+// windows_json: {"rid": [samples...]} -> {"rid": score}
+int tft_health_scores(const char* windows_json, const char* opts_json,
+                      char** result, char** err) {
+  TFT_TRY({
+    Json jw = Json::parse(windows_json);
+    HealthOpts opts = HealthOpts::from_json(Json::parse(opts_json));
+    std::map<std::string, std::vector<double>> windows;
+    for (const auto& [rid, arr] : jw.as_object()) {
+      std::vector<double> w;
+      for (const auto& v : arr.as_array()) w.push_back(v.as_double());
+      windows[rid] = w;
+    }
+    auto scores = straggler_scores(windows, opts);
+    Json out = Json::object();
+    for (const auto& [rid, s] : scores) out[rid] = s;
+    if (result) *result = dup_str(out.dump());
+    return TFT_OK;
+  })
+}
+
+// Deterministic ledger replay on a synthetic clock. opts_json: HealthOpts
+// fields plus "heartbeat_timeout_ms" and "min_replicas". script_json: array
+// of {"t_ms": N, "replica_id": ..., "telemetry": {...}?} beats and
+// {"t_ms": N, "tick": true} ticks, applied in order.
+int tft_health_replay(const char* script_json, const char* opts_json,
+                      char** result, char** err) {
+  TFT_TRY({
+    Json js = Json::parse(script_json);
+    Json jo = Json::parse(opts_json);
+    HealthOpts opts = HealthOpts::from_json(jo);
+    int64_t hb_timeout =
+        jo.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
+    int64_t min_replicas =
+        jo.get_or("min_replicas", Json(int64_t{1})).as_int();
+    HealthLedger ledger(opts, hb_timeout, min_replicas);
+
+    TimePoint base = Clock::now();
+    int64_t last_t = 0;
+    Json events = Json::array();
+    for (const auto& entry : js.as_array()) {
+      int64_t t_ms = entry.get_or("t_ms", Json(int64_t{0})).as_int();
+      last_t = t_ms;
+      TimePoint now = base + Millis(t_ms);
+      std::vector<Json> evs;
+      if (entry.get_or("tick", Json(false)).as_bool()) {
+        evs = ledger.tick(
+            now, entry.get_or("prune_after_ms", Json(10 * hb_timeout)).as_int());
+      } else {
+        std::string rid = entry.get("replica_id").as_string();
+        const Json* telemetry = nullptr;
+        Json t;
+        if (entry.contains("telemetry") && !entry.get("telemetry").is_null()) {
+          t = entry.get("telemetry");
+          telemetry = &t;
+        }
+        evs = ledger.on_heartbeat(rid, telemetry, now);
+      }
+      for (auto& e : evs) {
+        e["t_ms"] = t_ms;
+        events.push_back(e);
+      }
+    }
+    Json out = Json::object();
+    out["events"] = events;
+    out["ledger"] = ledger.to_json(base + Millis(last_t));
+    Json ex = Json::array();
+    for (const auto& rid : ledger.exclusions()) ex.push_back(rid);
+    out["excluded"] = ex;
     if (result) *result = dup_str(out.dump());
     return TFT_OK;
   })

@@ -1,0 +1,330 @@
+#include "healthwatch.h"
+
+#include <algorithm>
+#include <cmath>
+
+#include "quorum.h"  // epoch_millis_now
+
+namespace tft {
+
+namespace {
+
+constexpr size_t kMaxRecentEvents = 64;
+
+double median_of(std::vector<double> v) {
+  if (v.empty()) return 0.0;
+  std::sort(v.begin(), v.end());
+  size_t n = v.size();
+  if (n % 2 == 1) return v[n / 2];
+  return 0.5 * (v[n / 2 - 1] + v[n / 2]);
+}
+
+}  // namespace
+
+HealthOpts HealthOpts::from_json(const Json& j) {
+  HealthOpts o;
+  o.mode = j.get_or("mode", Json(o.mode)).as_string();
+  o.window = j.get_or("window", Json(o.window)).as_int();
+  o.min_samples = j.get_or("min_samples", Json(o.min_samples)).as_int();
+  o.warn_z = j.get_or("warn_z", Json(o.warn_z)).as_double();
+  o.eject_z = j.get_or("eject_z", Json(o.eject_z)).as_double();
+  o.eject_steps = j.get_or("eject_steps", Json(o.eject_steps)).as_int();
+  o.probation_ms = j.get_or("probation_ms", Json(o.probation_ms)).as_int();
+  o.probe_ok = j.get_or("probe_ok", Json(o.probe_ok)).as_int();
+  o.rel_floor = j.get_or("rel_floor", Json(o.rel_floor)).as_double();
+  return o;
+}
+
+Json HealthOpts::to_json() const {
+  Json j = Json::object();
+  j["mode"] = mode;
+  j["window"] = window;
+  j["min_samples"] = min_samples;
+  j["warn_z"] = warn_z;
+  j["eject_z"] = eject_z;
+  j["eject_steps"] = eject_steps;
+  j["probation_ms"] = probation_ms;
+  j["probe_ok"] = probe_ok;
+  j["rel_floor"] = rel_floor;
+  return j;
+}
+
+const char* health_state_name(HealthState s) {
+  switch (s) {
+    case HealthState::kOk: return "ok";
+    case HealthState::kWarn: return "warn";
+    case HealthState::kEjected: return "ejected";
+    case HealthState::kProbation: return "probation";
+  }
+  return "ok";
+}
+
+std::map<std::string, double> straggler_scores(
+    const std::map<std::string, std::vector<double>>& windows,
+    const HealthOpts& opts) {
+  std::map<std::string, double> scores;
+  // Per-replica robust statistic: the median of its window.
+  std::map<std::string, double> stats;
+  for (const auto& [rid, w] : windows) {
+    scores[rid] = 0.0;
+    if (static_cast<int64_t>(w.size()) >= opts.min_samples)
+      stats[rid] = median_of(w);
+  }
+  if (stats.size() < 2) return scores;  // no peer group to compare against
+
+  std::vector<double> xs;
+  for (const auto& [rid, x] : stats) xs.push_back(x);
+  double med = median_of(xs);
+  std::vector<double> devs;
+  for (double x : xs) devs.push_back(std::fabs(x - med));
+  double mad = median_of(devs);
+  // Modified z-score scale, floored: MAD is 0 on a homogeneous fleet (the
+  // straggler is the only deviation and the median of deviations vanishes),
+  // so fall back to a fraction of the median itself.
+  double scale = std::max({mad / 0.6745, opts.rel_floor * std::max(med, 0.0),
+                           1e-9});
+  for (const auto& [rid, x] : stats)
+    scores[rid] = std::max(0.0, x - med) / scale;  // only SLOW is unhealthy
+  return scores;
+}
+
+HealthLedger::HealthLedger(HealthOpts opts, int64_t heartbeat_timeout_ms,
+                           int64_t min_replicas)
+    : opts_(std::move(opts)),
+      heartbeat_timeout_ms_(heartbeat_timeout_ms),
+      min_replicas_(min_replicas) {}
+
+bool HealthLedger::can_eject(TimePoint now) const {
+  // Ejecting must leave at least min_replicas live, non-excluded replicas.
+  int64_t live = 0;
+  for (const auto& [rid, rh] : replicas_) {
+    if (excluded_.count(rid)) continue;
+    if (now - rh.last_beat < Millis(heartbeat_timeout_ms_)) live += 1;
+  }
+  return live - 1 >= min_replicas_;
+}
+
+void HealthLedger::eject(const std::string& rid, ReplicaHealth& rh,
+                         TimePoint now, std::vector<Json>* events) {
+  rh.state = HealthState::kEjected;
+  rh.ejections += 1;
+  rh.strikes = 0;
+  rh.probes_ok = 0;
+  rh.ejected_at = now;
+  // Probation judges post-recovery samples only. last_step is kept: the
+  // beat loop keeps re-sending the last pre-ejection (dilated) telemetry
+  // until the replica actually steps again, and re-ingesting it on the
+  // first probation beat would re-eject a replica that never got to run.
+  rh.window.clear();
+  excluded_.insert(rid);
+  Json e = Json::object();
+  e["kind"] = std::string("eject");
+  e["replica_id"] = rid;
+  e["score"] = rh.score;
+  e["ejections"] = rh.ejections;
+  e["ms"] = epoch_millis_now();
+  events->push_back(e);
+}
+
+void HealthLedger::evaluate(const std::string& rid, TimePoint now,
+                            std::vector<Json>* events) {
+  std::map<std::string, std::vector<double>> windows;
+  for (const auto& [r, rh] : replicas_) {
+    if (excluded_.count(r)) continue;  // ejected replicas have no window
+    windows[r] = std::vector<double>(rh.window.begin(), rh.window.end());
+  }
+  auto scores = straggler_scores(windows, opts_);
+  for (auto& [r, rh] : replicas_)
+    if (scores.count(r)) rh.score = scores[r];
+
+  auto it = replicas_.find(rid);
+  if (it == replicas_.end()) return;
+  ReplicaHealth& rh = it->second;
+  double s = rh.score;
+
+  if (rh.state == HealthState::kProbation) {
+    if (s > opts_.eject_z) {  // one strike in probation: straight back out
+      if (opts_.mode == "eject" && can_eject(now)) {
+        eject(rid, rh, now, events);
+      }
+      return;
+    }
+    // probes only count once the rebuilt window is scorable — an unscored
+    // warmup sample (score pinned at 0) says nothing about recovery
+    if (static_cast<int64_t>(rh.window.size()) < opts_.min_samples) return;
+    rh.probes_ok += 1;
+    if (rh.probes_ok >= opts_.probe_ok) {
+      rh.state = s > opts_.warn_z ? HealthState::kWarn : HealthState::kOk;
+      rh.probes_ok = 0;
+    }
+    return;
+  }
+
+  // ok / warn
+  if (s > opts_.eject_z)
+    rh.strikes += 1;
+  else
+    rh.strikes = 0;
+
+  if (s > opts_.warn_z && rh.state == HealthState::kOk) {
+    rh.state = HealthState::kWarn;
+    Json e = Json::object();
+    e["kind"] = std::string("straggler_warn");
+    e["replica_id"] = rid;
+    e["score"] = s;
+    e["warn_z"] = opts_.warn_z;
+    e["ms"] = epoch_millis_now();
+    events->push_back(e);
+  } else if (s <= opts_.warn_z && rh.state == HealthState::kWarn) {
+    rh.state = HealthState::kOk;
+  }
+
+  if (rh.strikes >= opts_.eject_steps) {
+    if (opts_.mode == "eject" && can_eject(now)) {
+      eject(rid, rh, now, events);
+    } else {
+      // observe mode (or ejection would drop below min_replicas): report
+      // that the policy WOULD eject, re-arm instead of spamming per sample
+      Json e = Json::object();
+      e["kind"] = std::string("straggler_warn");
+      e["replica_id"] = rid;
+      e["score"] = s;
+      e["would_eject"] = true;
+      e["reason"] = opts_.mode == "eject"
+                        ? std::string("min_replicas floor")
+                        : std::string("mode=") + opts_.mode;
+      e["ms"] = epoch_millis_now();
+      events->push_back(e);
+      rh.strikes = 0;
+    }
+  }
+}
+
+std::vector<Json> HealthLedger::on_heartbeat(const std::string& rid,
+                                             const Json* telemetry,
+                                             TimePoint now) {
+  std::vector<Json> events;
+  if (opts_.mode == "off") return events;
+  ReplicaHealth& rh = replicas_[rid];
+  bool first = rh.samples_total == 0 && rh.last_beat == TimePoint{};
+  // Probation demands CONTINUOUS fresh beats: a gap restarts the clock.
+  if (rh.state == HealthState::kEjected && !first &&
+      now - rh.last_beat > Millis(heartbeat_timeout_ms_))
+    rh.ejected_at = now;
+  rh.last_beat = now;
+
+  if (telemetry != nullptr && telemetry->is_object() &&
+      telemetry->contains("step") && rh.state != HealthState::kEjected) {
+    int64_t step = telemetry->get("step").as_int();
+    if (step > rh.last_step) {  // dedup: the beat loop re-sends the latest
+      rh.last_step = step;
+      double step_s = telemetry->get_or("step_s", Json(0.0)).as_double();
+      double wire_s = telemetry->get_or("wire_s", Json(0.0)).as_double();
+      rh.last_step_s = step_s;
+      rh.last_wire_s = wire_s;
+      // Score compute time, not wall time: the allreduce barrier equalizes
+      // wall time across the quorum (everyone waits for the straggler), so
+      // the straggler is the replica with high step_s minus wire wait.
+      double sample = std::max(step_s - wire_s, 0.0);
+      rh.window.push_back(sample);
+      while (static_cast<int64_t>(rh.window.size()) > opts_.window)
+        rh.window.pop_front();
+      rh.samples_total += 1;
+      evaluate(rid, now, &events);
+    }
+  }
+  remember(events);
+  return events;
+}
+
+std::vector<Json> HealthLedger::tick(TimePoint now, int64_t prune_after_ms) {
+  std::vector<Json> events;
+  if (opts_.mode == "off") return events;
+  for (auto it = replicas_.begin(); it != replicas_.end();) {
+    const std::string& rid = it->first;
+    ReplicaHealth& rh = it->second;
+    if (now - rh.last_beat > Millis(prune_after_ms)) {
+      excluded_.erase(rid);
+      it = replicas_.erase(it);
+      continue;
+    }
+    if (rh.state == HealthState::kEjected &&
+        now - rh.ejected_at >= Millis(opts_.probation_ms) &&
+        now - rh.last_beat < Millis(heartbeat_timeout_ms_)) {
+      rh.state = HealthState::kProbation;
+      rh.readmissions += 1;
+      rh.probes_ok = 0;
+      excluded_.erase(rid);
+      Json e = Json::object();
+      e["kind"] = std::string("readmit");
+      e["replica_id"] = rid;
+      e["readmissions"] = rh.readmissions;
+      e["ms"] = epoch_millis_now();
+      events.push_back(e);
+    }
+    ++it;
+  }
+  remember(events);
+  return events;
+}
+
+void HealthLedger::remember(const std::vector<Json>& events) {
+  for (const auto& e : events) {
+    recent_events_.push_back(e);
+    while (recent_events_.size() > kMaxRecentEvents) recent_events_.pop_front();
+  }
+}
+
+Json HealthLedger::replica_json(const std::string& rid) const {
+  Json j = Json::object();
+  j["mode"] = opts_.mode;
+  auto it = replicas_.find(rid);
+  if (it == replicas_.end()) {
+    j["state"] = std::string("ok");
+    j["state_code"] = int64_t{0};
+    return j;
+  }
+  const ReplicaHealth& rh = it->second;
+  j["state"] = std::string(health_state_name(rh.state));
+  j["state_code"] = static_cast<int64_t>(rh.state);
+  j["score"] = rh.score;
+  j["samples"] = rh.samples_total;
+  j["ejections"] = rh.ejections;
+  j["readmissions"] = rh.readmissions;
+  return j;
+}
+
+Json HealthLedger::to_json(TimePoint now) const {
+  Json j = Json::object();
+  j["mode"] = opts_.mode;
+  j["opts"] = opts_.to_json();
+  Json reps = Json::object();
+  for (const auto& [rid, rh] : replicas_) {
+    Json r = Json::object();
+    r["state"] = std::string(health_state_name(rh.state));
+    r["score"] = rh.score;
+    r["samples"] = rh.samples_total;
+    r["window"] = static_cast<int64_t>(rh.window.size());
+    r["window_median"] =
+        median_of(std::vector<double>(rh.window.begin(), rh.window.end()));
+    r["last_step"] = rh.last_step;
+    r["last_step_s"] = rh.last_step_s;
+    r["last_wire_s"] = rh.last_wire_s;
+    r["strikes"] = rh.strikes;
+    r["ejections"] = rh.ejections;
+    r["readmissions"] = rh.readmissions;
+    r["last_beat_ms_ago"] = static_cast<int64_t>(
+        std::chrono::duration_cast<Millis>(now - rh.last_beat).count());
+    reps[rid] = r;
+  }
+  j["replicas"] = reps;
+  Json ex = Json::array();
+  for (const auto& rid : excluded_) ex.push_back(rid);
+  j["excluded"] = ex;
+  Json ev = Json::array();
+  for (const auto& e : recent_events_) ev.push_back(e);
+  j["recent_events"] = ev;
+  return j;
+}
+
+}  // namespace tft

@@ -1,0 +1,125 @@
+// Healthwatch: per-replica health ledger for the lighthouse.
+//
+// Quorum health was binary (heartbeat fresh or stale, quorum.cc:71-76); a
+// slow-but-alive replica drags every synchronous step because the managed
+// allreduce is a barrier across the quorum. The ledger keeps a rolling
+// window of per-step compute-time samples per replica (reported as optional
+// telemetry on the existing heartbeat), scores each replica against the
+// quorum median (modified z-score: median + MAD, with a relative floor on
+// the scale because MAD degenerates to zero on a homogeneous fleet), and
+// runs the escalation policy:
+//
+//   ok -> warn          score > warn_z (event: straggler_warn)
+//   warn -> ejected     score > eject_z for eject_steps consecutive samples,
+//                       mode == "eject" only, never below min_replicas
+//                       (event: eject; replica enters the exclusion set the
+//                       quorum computation consults)
+//   ejected -> probation  probation_ms of continuous fresh heartbeats
+//                       (event: readmit; replica leaves the exclusion set)
+//   probation -> ok     probe_ok clean samples; one sample over eject_z
+//                       re-ejects immediately
+//
+// In "observe" mode (the default) the ledger scores and reports but never
+// ejects, so existing jobs see zero behavior change. The scoring math is
+// mirrored by torchft_tpu/healthwatch.py (the canonical spec) and parity
+// tested through the capi replay hooks.
+#pragma once
+
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "net.h"
+
+namespace tft {
+
+struct HealthOpts {
+  std::string mode = "observe";  // "off" | "observe" | "eject"
+  int64_t window = 32;           // samples kept per replica
+  int64_t min_samples = 5;       // warmup: score only with this many samples
+  double warn_z = 3.0;           // score above this -> warn
+  double eject_z = 6.0;          // score above this counts an eject strike
+  int64_t eject_steps = 3;       // consecutive strikes before ejection
+  int64_t probation_ms = 10000;  // continuous fresh beats before readmission
+  int64_t probe_ok = 3;          // clean samples in probation before ok
+  double rel_floor = 0.05;       // scale floor as a fraction of the median
+
+  static HealthOpts from_json(const Json& j);
+  Json to_json() const;
+};
+
+enum class HealthState { kOk = 0, kWarn = 1, kEjected = 2, kProbation = 3 };
+const char* health_state_name(HealthState s);
+
+// Pure scoring: per-replica straggler score from rolling windows of
+// compute-time samples. Replicas with fewer than min_samples samples are
+// not scored (warmup grace) and do not contribute to the quorum median.
+// Fewer than 2 scorable replicas -> all zeros (no peer group to compare).
+std::map<std::string, double> straggler_scores(
+    const std::map<std::string, std::vector<double>>& windows,
+    const HealthOpts& opts);
+
+struct ReplicaHealth {
+  std::deque<double> window;  // compute-time samples (step_s - wire_s)
+  int64_t last_step = -1;     // dedup: one sample per reported step
+  double last_step_s = 0.0;
+  double last_wire_s = 0.0;
+  double score = 0.0;
+  HealthState state = HealthState::kOk;
+  int64_t strikes = 0;    // consecutive samples over eject_z
+  int64_t probes_ok = 0;  // clean samples while in probation
+  int64_t ejections = 0;
+  int64_t readmissions = 0;
+  int64_t samples_total = 0;
+  TimePoint ejected_at{};
+  TimePoint last_beat{};
+};
+
+class HealthLedger {
+ public:
+  HealthLedger(HealthOpts opts, int64_t heartbeat_timeout_ms,
+               int64_t min_replicas);
+
+  const HealthOpts& opts() const { return opts_; }
+
+  // Feed one heartbeat; telemetry may be null (plain beat). Returns the
+  // policy events this beat produced ({"kind": "straggler_warn" | "eject" |
+  // "readmit", "replica_id": ..., ...}).
+  std::vector<Json> on_heartbeat(const std::string& rid, const Json* telemetry,
+                                 TimePoint now);
+
+  // Periodic evaluation: probation transitions (time-based) and pruning of
+  // long-dead replicas (same horizon the lighthouse uses for heartbeats).
+  std::vector<Json> tick(TimePoint now, int64_t prune_after_ms);
+
+  const std::set<std::string>& exclusions() const { return excluded_; }
+
+  // Per-replica summary returned in the heartbeat response (so the Manager
+  // can surface health_state / ejections / readmissions in timings()).
+  Json replica_json(const std::string& rid) const;
+
+  // Full ledger dump for the /health endpoint.
+  Json to_json(TimePoint now) const;
+
+ private:
+  // Recompute every replica's score from current windows; run the policy
+  // for `rid` (the replica that just delivered a new sample).
+  void evaluate(const std::string& rid, TimePoint now,
+                std::vector<Json>* events);
+  bool can_eject(TimePoint now) const;
+  void eject(const std::string& rid, ReplicaHealth& rh, TimePoint now,
+             std::vector<Json>* events);
+  void remember(const std::vector<Json>& events);
+
+  HealthOpts opts_;
+  int64_t heartbeat_timeout_ms_;
+  int64_t min_replicas_;
+  std::map<std::string, ReplicaHealth> replicas_;
+  std::set<std::string> excluded_;
+  std::deque<Json> recent_events_;  // bounded tail for /health
+};
+
+}  // namespace tft

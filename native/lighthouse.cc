@@ -30,8 +30,11 @@ std::string esc(const std::string& s) {
 }
 }  // namespace
 
-Lighthouse::Lighthouse(const std::string& bind, LighthouseOpts opts)
-    : opts_(opts) {
+Lighthouse::Lighthouse(const std::string& bind, LighthouseOpts opts,
+                       HealthOpts health)
+    : opts_(opts),
+      ledger_(std::move(health), opts.heartbeat_timeout_ms,
+              opts.min_replicas) {
   server_ = std::make_unique<RpcServer>(
       bind,
       [this](const std::string& m, const Json& p, TimePoint d) {
@@ -78,6 +81,10 @@ void Lighthouse::quorum_tick_locked() {
     else
       ++it;
   }
+  // Health ledger tick: probation -> readmission transitions (time-based)
+  // and pruning on the same 10x horizon as the heartbeat map above.
+  apply_health_events_locked(
+      ledger_.tick(now, 10 * opts_.heartbeat_timeout_ms));
   auto [met, reason] = quorum_compute(Clock::now(), state_, opts_);
   if (reason != last_reason_) {
     log_info(reason);
@@ -122,6 +129,7 @@ Json Lighthouse::handle(const std::string& method, const Json& params,
   if (method == "quorum") return rpc_quorum(params, deadline);
   if (method == "heartbeat") return rpc_heartbeat(params);
   if (method == "status") return status_json();
+  if (method == "health") return health_json();
   throw RpcError("invalid", "unknown lighthouse method: " + method);
 }
 
@@ -130,8 +138,11 @@ Json Lighthouse::rpc_quorum(const Json& params, TimePoint deadline) {
   log_info("Received quorum request for replica " + requester.replica_id);
 
   std::unique_lock<std::mutex> lk(mu_);
-  // Implicit heartbeat + join.
+  // Implicit heartbeat + join (the ledger tracks beat continuity too: an
+  // ejected replica's probation clock must not reset just because its
+  // beats arrive via quorum retries instead of the beat loop).
   state_.heartbeats[requester.replica_id] = Clock::now();
+  ledger_.on_heartbeat(requester.replica_id, nullptr, Clock::now());
   state_.participants[requester.replica_id] =
       MemberDetails{Clock::now(), requester};
   uint64_t waiting_gen = quorum_gen_;
@@ -166,16 +177,43 @@ Json Lighthouse::rpc_quorum(const Json& params, TimePoint deadline) {
     // expired mid-wait would otherwise be excluded as unhealthy on every
     // retry and spin until its deadline
     state_.heartbeats[requester.replica_id] = Clock::now();
+    ledger_.on_heartbeat(requester.replica_id, nullptr, Clock::now());
   }
 }
 
 Json Lighthouse::rpc_heartbeat(const Json& params) {
   std::string replica_id = params.get("replica_id").as_string();
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    state_.heartbeats[replica_id] = Clock::now();
+  std::lock_guard<std::mutex> lk(mu_);
+  auto now = Clock::now();
+  state_.heartbeats[replica_id] = now;
+  // Optional telemetry payload rides the existing beat; the ledger dedups
+  // by step so re-sent payloads cost nothing.
+  const Json* telemetry = nullptr;
+  Json t;
+  if (params.contains("telemetry") && !params.get("telemetry").is_null()) {
+    t = params.get("telemetry");
+    telemetry = &t;
   }
-  return Json::object();
+  apply_health_events_locked(ledger_.on_heartbeat(replica_id, telemetry, now));
+  // The response carries this replica's health summary back to its Manager
+  // (surfaced in Manager.timings() and the torchft_health event stream).
+  Json out = Json::object();
+  out["health"] = ledger_.replica_json(replica_id);
+  return out;
+}
+
+void Lighthouse::apply_health_events_locked(const std::vector<Json>& events) {
+  for (const auto& e : events)
+    log_info("health: " + e.dump());
+  state_.excluded = ledger_.exclusions();
+}
+
+Json Lighthouse::health_json() {
+  std::lock_guard<std::mutex> lk(mu_);
+  Json j = ledger_.to_json(Clock::now());
+  j["quorum_id"] = state_.quorum_id;
+  j["min_replicas"] = opts_.min_replicas;
+  return j;
 }
 
 Json Lighthouse::status_json() {
@@ -194,6 +232,9 @@ Json Lighthouse::status_json() {
         std::chrono::duration_cast<Millis>(now - last).count());
   }
   j["heartbeat_ages_ms"] = beats;
+  Json ex = Json::array();
+  for (const auto& rid : state_.excluded) ex.push_back(rid);
+  j["excluded"] = ex;
   return j;
 }
 
@@ -233,6 +274,7 @@ std::tuple<std::string, std::string, std::string> Lighthouse::handle_http(
     if (path == "/" || path == "/index.html")
       return {"200 OK", "text/html", status_html()};
     if (path == "/status") return {"200 OK", "application/json", status_json().dump()};
+    if (path == "/health") return {"200 OK", "application/json", health_json().dump()};
     // POST /replica/{id}/kill — forward a Kill RPC to that replica's manager.
     const std::string prefix = "/replica/";
     if (path.rfind(prefix, 0) == 0 && path.size() > prefix.size()) {

@@ -11,6 +11,7 @@
 #include <mutex>
 #include <thread>
 
+#include "healthwatch.h"
 #include "quorum.h"
 #include "wire.h"
 
@@ -18,7 +19,8 @@ namespace tft {
 
 class Lighthouse {
  public:
-  Lighthouse(const std::string& bind, LighthouseOpts opts);
+  Lighthouse(const std::string& bind, LighthouseOpts opts,
+             HealthOpts health = HealthOpts{});
   ~Lighthouse();
 
   int port() const { return server_->port(); }
@@ -33,7 +35,10 @@ class Lighthouse {
   Json rpc_quorum(const Json& params, TimePoint deadline);
   Json rpc_heartbeat(const Json& params);
   Json status_json();
+  Json health_json();
   std::string status_html();
+  // Must hold mu_. Log + sync ledger exclusions into the quorum state.
+  void apply_health_events_locked(const std::vector<Json>& events);
 
   void tick_loop();
   // Must hold mu_. Runs one quorum computation; publishes on success.
@@ -43,6 +48,7 @@ class Lighthouse {
   std::mutex mu_;
   std::condition_variable quorum_cv_;
   LighthouseState state_;
+  HealthLedger ledger_;  // guarded by mu_
   // Broadcast channel: bump generation + store latest quorum.
   uint64_t quorum_gen_ = 0;
   std::optional<QuorumSnapshot> latest_quorum_;

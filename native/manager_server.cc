@@ -48,17 +48,36 @@ void ManagerServer::shutdown() {
   server_->shutdown();
 }
 
+void ManagerServer::publish_telemetry(const std::string& telemetry_json) {
+  Json t = Json::parse(telemetry_json);
+  std::lock_guard<std::mutex> lk(telemetry_mu_);
+  telemetry_ = std::move(t);
+}
+
+std::string ManagerServer::health_json() const {
+  std::lock_guard<std::mutex> lk(telemetry_mu_);
+  return last_health_.empty() ? "{}" : last_health_;
+}
+
 void ManagerServer::heartbeat_loop() {
   while (running_.load()) {
     try {
       Json params = Json::object();
       params["replica_id"] = opts_.replica_id;
+      {
+        std::lock_guard<std::mutex> lk(telemetry_mu_);
+        if (!telemetry_.is_null()) params["telemetry"] = telemetry_;
+      }
       // Short per-beat timeout: the loop is serial, so one RPC stalling for
       // the full connect timeout (default 10s) would starve the beat past
       // the lighthouse's 5s expiry and get a LIVE replica evicted. 2s keeps
       // several retries inside the expiry window.
       int64_t beat_ms = std::min<int64_t>(opts_.connect_timeout_ms, 2000);
-      heartbeat_client_->call("heartbeat", params, Millis(beat_ms));
+      Json resp = heartbeat_client_->call("heartbeat", params, Millis(beat_ms));
+      if (resp.contains("health")) {
+        std::lock_guard<std::mutex> lk(telemetry_mu_);
+        last_health_ = resp.get("health").dump();
+      }
     } catch (const std::exception& e) {
       log_info(opts_.replica_id,
                std::string("failed to send heartbeat to lighthouse: ") + e.what());

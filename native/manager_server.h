@@ -40,6 +40,13 @@ class ManagerServer {
   std::string address() const;
   void shutdown();
 
+  // Healthwatch: the Manager publishes per-step telemetry (step, step_s,
+  // wire_s, counters) and the beat loop piggybacks the latest payload on
+  // every heartbeat; the lighthouse's response carries this replica's
+  // health summary back, readable via health_json().
+  void publish_telemetry(const std::string& telemetry_json);
+  std::string health_json() const;  // "{}" until the first beat round-trips
+
  private:
   Json handle(const std::string& method, const Json& params, TimePoint deadline);
   Json rpc_quorum(const Json& params, TimePoint deadline);
@@ -73,6 +80,13 @@ class ManagerServer {
   };
   std::condition_variable commit_cv_;
   std::map<int64_t, CommitRound> commit_rounds_;
+
+  // Telemetry/health exchange with the beat loop; separate mutex so a
+  // publish from the training hot loop never waits behind a quorum barrier
+  // holding mu_.
+  mutable std::mutex telemetry_mu_;
+  Json telemetry_;            // latest published payload (null = none)
+  std::string last_health_;   // last heartbeat response's "health" field
 
   std::atomic<bool> running_{true};
   std::unique_ptr<RpcServer> server_;

@@ -9,6 +9,7 @@
 #include <thread>
 #include <vector>
 
+#include "healthwatch.h"
 #include "kvstore.h"
 #include "lighthouse.h"
 #include "manager_server.h"
@@ -463,6 +464,130 @@ static void test_lighthouse_manager_e2e() {
   lighthouse.shutdown();
 }
 
+// -------------------------------------------------------------- healthwatch
+static void test_health_scores_straggler() {
+  HealthOpts opts;
+  opts.min_samples = 3;
+  std::map<std::string, std::vector<double>> windows;
+  windows["a"] = {1.0, 1.0, 1.0, 1.0};
+  windows["b"] = {1.0, 1.1, 0.9, 1.0};
+  windows["c"] = {10.0, 10.0, 10.0, 10.0};
+  windows["warming"] = {10.0};  // below min_samples: unscored, no influence
+  auto scores = straggler_scores(windows, opts);
+  CHECK(scores["c"] > opts.eject_z);
+  CHECK(scores["a"] < opts.warn_z);
+  CHECK(scores["b"] < opts.warn_z);
+  CHECK(scores["warming"] == 0.0);
+  // 1-replica peer group: nothing to compare against
+  std::map<std::string, std::vector<double>> solo;
+  solo["a"] = {10.0, 10.0, 10.0};
+  auto s1 = straggler_scores(solo, opts);
+  CHECK(s1["a"] == 0.0);
+}
+
+static void test_health_ledger_eject_and_readmit() {
+  HealthOpts opts;
+  opts.mode = "eject";
+  opts.min_samples = 3;
+  opts.eject_steps = 2;
+  opts.probation_ms = 1000;
+  opts.probe_ok = 2;
+  HealthLedger ledger(opts, /*heartbeat_timeout_ms=*/5000, /*min_replicas=*/1);
+  TimePoint base = Clock::now();
+  auto beat = [&](const std::string& rid, int64_t step, double step_s,
+                  int64_t t_ms) {
+    Json t = Json::object();
+    t["step"] = step;
+    t["step_s"] = step_s;
+    t["wire_s"] = 0.0;
+    return ledger.on_heartbeat(rid, &t, base + Millis(t_ms));
+  };
+  bool ejected = false, warned = false;
+  for (int64_t step = 1; step <= 8 && !ejected; ++step) {
+    beat("a", step, 1.0, step * 10);
+    beat("b", step, 1.0, step * 10);
+    for (const auto& e : beat("c", step, 10.0, step * 10)) {
+      std::string kind = e.get("kind").as_string();
+      if (kind == "straggler_warn") warned = true;
+      if (kind == "eject") ejected = true;
+    }
+  }
+  CHECK(warned);
+  CHECK(ejected);
+  CHECK(ledger.exclusions().count("c") == 1);
+  // Samples while ejected are ignored; beats keep last_beat fresh.
+  beat("c", 9, 10.0, 100);
+  CHECK(ledger.exclusions().count("c") == 1);
+  // Before the probation window: no readmission.
+  auto evs = ledger.tick(base + Millis(500), 50000);
+  CHECK(evs.empty());
+  // After probation_ms of fresh beats: readmitted on probation.
+  ledger.on_heartbeat("c", nullptr, base + Millis(1200));
+  evs = ledger.tick(base + Millis(1200), 50000);
+  CHECK(evs.size() == 1 && evs[0].get("kind").as_string() == "readmit");
+  CHECK(ledger.exclusions().count("c") == 0);
+  // Clean post-recovery samples walk probation back to ok.
+  for (int64_t step = 20; step <= 26; ++step) {
+    beat("a", step, 1.0, 1300 + step);
+    beat("b", step, 1.0, 1300 + step);
+    beat("c", step, 1.0, 1300 + step);
+  }
+  Json rj = ledger.replica_json("c");
+  CHECK(rj.get("state").as_string() == "ok");
+  CHECK(rj.get("ejections").as_int() == 1);
+  CHECK(rj.get("readmissions").as_int() == 1);
+}
+
+static void test_health_never_ejects_below_min_replicas() {
+  HealthOpts opts;
+  opts.mode = "eject";
+  opts.min_samples = 3;
+  opts.eject_steps = 2;
+  HealthLedger ledger(opts, 5000, /*min_replicas=*/2);
+  TimePoint base = Clock::now();
+  auto beat = [&](const std::string& rid, int64_t step, double step_s) {
+    Json t = Json::object();
+    t["step"] = step;
+    t["step_s"] = step_s;
+    t["wire_s"] = 0.0;
+    return ledger.on_heartbeat(rid, &t, base + Millis(step * 10));
+  };
+  // 2-replica fleet with min_replicas=2: the straggler can never be
+  // ejected (and the symmetric 2-point score stays tiny anyway).
+  bool ejected = false;
+  for (int64_t step = 1; step <= 10; ++step) {
+    beat("a", step, 1.0);
+    for (const auto& e : beat("b", step, 10.0))
+      if (e.get("kind").as_string() == "eject") ejected = true;
+  }
+  CHECK(!ejected);
+  CHECK(ledger.exclusions().empty());
+}
+
+static void test_quorum_excluded_replica() {
+  LighthouseOpts opts;
+  opts.min_replicas = 1;
+  opts.join_timeout_ms = 60000;
+  opts.heartbeat_timeout_ms = 5000;
+  TimePoint now = Clock::now();
+  LighthouseState state;
+  for (const auto& id : {"a", "b", "c"}) {
+    state.participants[id] = MemberDetails{now, member(id)};
+    state.heartbeats[id] = now;
+  }
+  QuorumSnapshot prev;
+  prev.quorum_id = 1;
+  prev.participants = {member("a"), member("b"), member("c")};
+  state.prev_quorum = prev;
+  state.excluded.insert("c");
+  // "c" is fresh but ejected: the quorum must form without it, and "c"
+  // must not veto the all-joined check (no join-timeout stall).
+  auto [met, reason] = quorum_compute(now, state, opts);
+  CHECK(met.has_value());
+  CHECK(met->size() == 2);
+  for (const auto& m : *met) CHECK(m.replica_id != "c");
+}
+
 int main() {
   test_quorum_fast_path();
   test_quorum_join_timeout_straggler();
@@ -475,6 +600,10 @@ int main() {
   test_results_store_spread_across_group_ranks();
   test_results_not_in_quorum();
   test_results_commit_failures_max();
+  test_health_scores_straggler();
+  test_health_ledger_eject_and_readmit();
+  test_health_never_ejects_below_min_replicas();
+  test_quorum_excluded_replica();
   test_wire_echo_and_timeout();
   test_kvstore();
   test_lighthouse_manager_e2e();

@@ -68,10 +68,14 @@ bool quorum_changed(const std::vector<QuorumMember>& a,
 
 std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
     TimePoint now, const LighthouseState& state, const LighthouseOpts& opts) {
-  // Health: a replica is healthy if its last heartbeat is fresh.
+  // Health: a replica is healthy if its last heartbeat is fresh AND the
+  // health ledger has not ejected it. Ejected replicas drop out of the
+  // healthy count entirely — they must neither join the quorum nor veto
+  // the majority / all-joined checks while serving their probation.
   std::set<std::string> healthy_replicas;
   for (const auto& [rid, last] : state.heartbeats) {
-    if (now - last < Millis(opts.heartbeat_timeout_ms))
+    if (now - last < Millis(opts.heartbeat_timeout_ms) &&
+        !state.excluded.count(rid))
       healthy_replicas.insert(rid);
   }
 
@@ -93,7 +97,9 @@ std::pair<std::optional<std::vector<QuorumMember>>, std::string> quorum_compute(
                          "/" + std::to_string(state.participants.size()) +
                          " participants healthy][" +
                          std::to_string(healthy_replicas.size()) +
-                         " heartbeating][shrink_only=" +
+                         " heartbeating][" +
+                         std::to_string(state.excluded.size()) +
+                         " excluded][shrink_only=" +
                          (shrink_only ? "true" : "false") + "]";
 
   // Fast quorum: every member of the previous quorum is healthy and has

@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <map>
 #include <optional>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -64,6 +65,10 @@ struct MemberDetails {
 struct LighthouseState {
   std::map<std::string, MemberDetails> participants;  // replica_id -> details
   std::map<std::string, TimePoint> heartbeats;        // replica_id -> last beat
+  // Replicas proactively ejected by the health ledger (healthwatch.h).
+  // Treated as unhealthy by quorum_compute even with fresh heartbeats, and
+  // removed from the healthy count so they neither join nor veto a quorum.
+  std::set<std::string> excluded;
   std::optional<QuorumSnapshot> prev_quorum;
   int64_t quorum_id = 0;
 };

@@ -6,7 +6,10 @@ harness: the real example trainer under a live Manager must emit
 ft_overhead_pct plus the per-phase cost splits. `--allreduce-pipeline
 --smoke` is the gate for the streaming bucket pipeline: serial vs
 streamed step walls plus the per-bucket stage splits and
-overlap_efficiency must survive end to end."""
+overlap_efficiency must survive end to end. `--healthwatch --smoke` is
+the gate for the health telemetry plane: the per-step publish+fold cost
+must stay under 1% of the managed step and /health must answer every
+poll made while the trainer is live."""
 
 import json
 import os
@@ -58,6 +61,18 @@ def test_bench_ft_overhead_smoke_emits_cost_splits():
     assert rec["allreduce_s"] > 0
     assert rec["should_commit_rpc_s"] > 0
     assert rec["bookkeeping_s"] >= 0
+
+
+def test_bench_healthwatch_smoke_holds_cost_and_serves_health():
+    rec = _run_bench("--healthwatch", "--smoke")
+    # the smoke run itself gates these; re-check the load-bearing ones so a
+    # silently-weakened healthwatch() still fails CI
+    assert rec["healthwatch_overhead_pct"] < 1.0
+    assert rec["healthwatch_publish_s"] > 0
+    assert rec["health_polls_ok"] > 0
+    assert rec["health_polls_failed"] == 0
+    assert rec["health_replicas_tracked"] >= 1
+    assert rec["health_mode"] == "observe"
 
 
 def test_bench_allreduce_pipeline_smoke_emits_stage_splits():

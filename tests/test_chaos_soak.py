@@ -101,6 +101,178 @@ def test_lighthouse_restart_and_mid_heal_source_kills():
 
 
 @pytest.mark.slow
+def test_straggler_ejected_recovers_readmitted_converges():
+    """Healthwatch chaos phase: a replica DEGRADES mid-run (starts
+    reporting 10x step time via the telemetry transform) under ``eject``
+    mode, is proactively excluded from the next quorum, recovers (the
+    degradation clears once the watcher sees the exclusion), is readmitted
+    after probation, heals from a live peer, and the run still converges
+    bitwise. The membership churn here is POLICY-driven (the lighthouse
+    ejected a live process) rather than crash-driven, so it exercises the
+    one transition the kill soaks cannot: an excluded replica that never
+    died re-entering the fleet through probationary readmission."""
+    from torchft_tpu._test.event_injector import EventInjector
+    from torchft_tpu.coordination import LighthouseClient
+
+    n_replicas = 3
+    target = 30
+    straggler = 2
+    degrade_after_commits = 6  # past warmup, so the OK window is warm
+    step_sleep_s = 0.03
+    health = {
+        "mode": "eject",
+        "window": 8,
+        "min_samples": 3,
+        "warn_z": 2.0,
+        "eject_z": 4.0,
+        "eject_steps": 2,
+        "probation_ms": 1500,
+        "probe_ok": 2,
+    }
+
+    injector = EventInjector()
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800, health=health,
+    )
+    client = LighthouseClient(f"127.0.0.1:{lh.port}", connect_timeout=5.0)
+    finals: dict = {}
+    commit_counts = {r: 0 for r in range(n_replicas)}
+    managers: dict = {}
+    fleet_done = threading.Event()
+    straggler_healed = threading.Event()
+    phases: dict = {}
+    failure: list = []
+
+    def replica(rid: int) -> None:
+        grad_base = np.random.RandomState(700 + rid).randn(8).astype(
+            np.float32
+        )
+        params = {"w": np.zeros(8, np.float32)}
+
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        manager = Manager(
+            pg=ProcessGroupHost(timeout=8.0),
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id=f"hwsoak_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=8.0,
+            quorum_timeout=4.0,
+            # telemetry rides heartbeats and the ledger samples one step
+            # per beat, so the beat must outpace the ~40 ms steps
+            heartbeat_interval=0.02,
+        )
+        manager.set_telemetry_transform(injector.telemetry_transform(rid))
+        managers[rid] = manager
+        zgrads = {"w": np.zeros(8, np.float32)}
+        try:
+            while manager.current_step() < target:
+                manager.start_quorum()
+                if manager.current_step() >= target:
+                    manager.allreduce(zgrads).get_future().wait(30)
+                    committed = manager.should_commit()
+                    # the heal flag is set when the pending state dict is
+                    # applied, which on the async-quorum plane happens
+                    # INSIDE should_commit — check after, not after
+                    # start_quorum
+                    if rid == straggler and manager.last_quorum_healed():
+                        straggler_healed.set()
+                    if committed:
+                        break
+                    continue
+                step = manager.current_step()
+                time.sleep(step_sleep_s)
+                g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+                avg = manager.allreduce({"w": g}).get_future().wait(30)
+                committed = manager.should_commit()
+                if rid == straggler and manager.last_quorum_healed():
+                    straggler_healed.set()
+                if committed:
+                    params["w"] = (
+                        params["w"] - LR * np.asarray(avg["w"])
+                    ).astype(np.float32)
+                    commit_counts[rid] += 1
+            finals[rid] = params["w"].copy()
+            if len(finals) == n_replicas:
+                # the last finisher can be the just-readmitted straggler,
+                # done within one heartbeat of readmission — run one
+                # settling drain cycle so the post-readmission health
+                # summary round-trips into timings() before teardown
+                time.sleep(0.1)
+                manager.start_quorum()
+                manager.allreduce(zgrads).get_future().wait(30)
+                manager.should_commit()
+                fleet_done.set()
+            while not fleet_done.is_set():
+                manager.start_quorum()
+                manager.allreduce(zgrads).get_future().wait(30)
+                manager.should_commit()
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+
+    ex = ThreadPoolExecutor(max_workers=n_replicas)
+    try:
+        futs = [ex.submit(replica, r) for r in range(n_replicas)]
+        deadline = time.monotonic() + 180.0
+        while not fleet_done.is_set() and time.monotonic() < deadline:
+            if failure:
+                break
+            if ("degraded" not in phases
+                    and commit_counts[straggler] >= degrade_after_commits):
+                injector.slow_replica(straggler, 10.0)
+                phases["degraded"] = dict(commit_counts)
+            try:
+                payload = client.health(timeout=2.0)
+            except Exception:  # noqa: BLE001 — poll races shutdown
+                payload = {}
+            if payload.get("excluded") and "ejected" not in phases:
+                # the degradation "recovers" the moment the policy acts,
+                # so probation probes see honest telemetry
+                injector.clear_slow_replica(straggler)
+                phases["ejected"] = dict(commit_counts)
+            time.sleep(0.05)
+        final_health = client.health()
+        for f in futs:
+            f.result(timeout=max(5.0, deadline - time.monotonic()))
+    finally:
+        fleet_done.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+
+    assert not failure, failure
+    assert "degraded" in phases, commit_counts
+    assert "ejected" in phases, (phases, final_health)
+    kinds = [e.get("kind") for e in final_health.get("recent_events", [])]
+    assert "eject" in kinds and "readmit" in kinds, final_health
+    assert straggler_healed.is_set(), (
+        "readmitted straggler never healed from a live peer"
+    )
+    # peers kept committing while the straggler was out
+    for rid in range(n_replicas):
+        if rid != straggler:
+            assert commit_counts[rid] > phases["ejected"][rid], (
+                rid, phases, commit_counts
+            )
+    t = managers[straggler].timings()
+    assert t["ejections"] >= 1 and t["readmissions"] >= 1, t
+    assert set(finals) == set(range(n_replicas)), finals.keys()
+    for rid in range(1, n_replicas):
+        np.testing.assert_array_equal(
+            finals[0], finals[rid],
+            err_msg=f"replica {rid} diverged after ejection/readmission",
+        )
+    assert np.isfinite(finals[0]).all()
+
+
+@pytest.mark.slow
 def test_extended_mixed_soak():
     """~4x15 s randomized kill/restart phases over the full plane x
     transport x world-size-mode matrix. Monotonicity: a replica's committed

@@ -8,7 +8,10 @@ plane: kill-the-heal-source-mid-transfer at chunk k / corrupt chunk k
 (armed on the serving transport via ``HTTPTransport.inject_chunk_fault``)
 and delayed/flaky control-plane RPCs (installed process-wide via
 ``coordination.set_rpc_fault_hook``), so the retry/failover machinery can
-be exercised deterministically.
+be exercised deterministically. For the healthwatch plane,
+``slow_replica`` dilates the step time a replica REPORTS (installed as a
+``Manager.set_telemetry_transform`` hook) so straggler scoring, proactive
+ejection, and probationary readmission run without real slowdowns.
 """
 
 from __future__ import annotations
@@ -61,6 +64,10 @@ class EventInjector:
         # method -> (remaining fire count, delay_s, error); drained by the
         # process-wide rpc fault hook installed by flake_rpc
         self._rpc_faults: Dict[str, Tuple[int, float, Optional[Exception]]] = {}
+        # replica -> step_s dilation factor for the healthwatch telemetry
+        # transform (slow_replica); mutable mid-run so a soak can degrade
+        # a replica and later let it recover
+        self._slow: Dict[int, float] = {}
         self.count = 0
 
     def stall_prepare_at(self, replica: int, step: int) -> "EventInjector":
@@ -143,6 +150,39 @@ class EventInjector:
                 EventKind.HEAL_CHUNK_CORRUPT, chunk=chunk, times=times
             )
         return self
+
+    # --------------------------------------------------------- healthwatch
+    def slow_replica(self, replica: int, factor: float) -> "EventInjector":
+        """Make ``replica`` REPORT ``factor``× its true step time in the
+        healthwatch telemetry (the replica does not actually slow down —
+        tests stay fast and deterministic). The lighthouse sees a
+        straggler and, under ``TORCHFT_HEALTH_MODE=eject``, excludes it
+        from the next quorum. Call again with ``factor=1.0`` (or
+        :meth:`clear_slow_replica`) to let it 'recover' and exercise
+        probationary readmission. Wire via
+        ``mgr.set_telemetry_transform(injector.telemetry_transform(r))``."""
+        with self._lock:
+            self._slow[replica] = float(factor)
+        return self
+
+    def clear_slow_replica(self, replica: int) -> None:
+        with self._lock:
+            self._slow.pop(replica, None)
+
+    def telemetry_transform(self, replica: int):
+        """A ``Manager.set_telemetry_transform`` hook bound to ``replica``
+        that applies the currently-armed dilation (live: re-arming or
+        clearing mid-run changes what the NEXT step reports)."""
+
+        def _transform(telemetry: Dict[str, float]) -> Dict[str, float]:
+            with self._lock:
+                factor = self._slow.get(replica)
+            if factor is not None and "step_s" in telemetry:
+                telemetry = dict(telemetry)
+                telemetry["step_s"] = telemetry["step_s"] * factor
+            return telemetry
+
+        return _transform
 
     # ------------------------------------------------- control-plane flakes
     def flake_rpc(

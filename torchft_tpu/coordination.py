@@ -83,6 +83,10 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_char_p),
         ]
+        lib.tft_lighthouse_new_v2.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
         lib.tft_lighthouse_address.argtypes = [ctypes.c_void_p]
         lib.tft_lighthouse_address.restype = ctypes.c_void_p
         lib.tft_lighthouse_port.argtypes = [ctypes.c_void_p]
@@ -94,6 +98,11 @@ def _load() -> ctypes.CDLL:
         ]
         lib.tft_manager_address.argtypes = [ctypes.c_void_p]
         lib.tft_manager_address.restype = ctypes.c_void_p
+        lib.tft_manager_publish_telemetry.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_manager_health.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_health.restype = ctypes.c_void_p
         lib.tft_manager_port.argtypes = [ctypes.c_void_p]
         lib.tft_manager_shutdown.argtypes = [ctypes.c_void_p]
         lib.tft_manager_free.argtypes = [ctypes.c_void_p]
@@ -120,6 +129,14 @@ def _load() -> ctypes.CDLL:
         lib.tft_compute_quorum_results.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_health_scores.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_health_replay.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
         ]
         _lib = lib
     return _lib
@@ -285,13 +302,28 @@ class LighthouseServer:
         join_timeout_ms: int = 60000,
         quorum_tick_ms: int = 100,
         heartbeat_timeout_ms: int = 5000,
+        health: "Optional[dict]" = None,
     ) -> None:
+        """``health`` configures the healthwatch ledger (HealthOpts fields,
+        see torchft_tpu/healthwatch.py); None reads ``TORCHFT_HEALTH_*``
+        from the environment (default: observe mode)."""
         lib = _load()
+        if health is None:
+            from torchft_tpu.healthwatch import HealthConfig
+
+            health = HealthConfig.from_env().to_json()
         handle = ctypes.c_void_p()
         err = ctypes.c_char_p()
-        status = lib.tft_lighthouse_new(
-            bind.encode(), min_replicas, join_timeout_ms, quorum_tick_ms,
-            heartbeat_timeout_ms, ctypes.byref(handle), ctypes.byref(err),
+        opts = {
+            "bind": bind,
+            "min_replicas": min_replicas,
+            "join_timeout_ms": join_timeout_ms,
+            "quorum_tick_ms": quorum_tick_ms,
+            "heartbeat_timeout_ms": heartbeat_timeout_ms,
+            "health": health,
+        }
+        status = lib.tft_lighthouse_new_v2(
+            json.dumps(opts).encode(), ctypes.byref(handle), ctypes.byref(err)
         )
         _raise_for_status(status, _take_str(lib, err), "lighthouse start failed")
         self._lib = lib
@@ -359,6 +391,28 @@ class ManagerServer:
 
     def address(self) -> str:
         return _take_str(self._lib, self._lib.tft_manager_address(self._handle))
+
+    def publish_telemetry(self, telemetry: dict) -> None:
+        """Set the per-step telemetry payload the background heartbeat
+        thread piggybacks on every beat (healthwatch plane). Keys the
+        lighthouse ledger reads: ``step``, ``step_s``, ``wire_s``; anything
+        else rides along for the /health dashboard."""
+        err = ctypes.c_char_p()
+        status = self._lib.tft_manager_publish_telemetry(
+            self._handle, json.dumps(telemetry).encode(), ctypes.byref(err)
+        )
+        _raise_for_status(
+            status, _take_str(self._lib, err), "publish_telemetry failed"
+        )
+
+    def health(self) -> dict:
+        """This replica's health summary from the last heartbeat response
+        (state / state_code / score / ejections / readmissions); ``{}``
+        until the first beat round-trips."""
+        return json.loads(
+            _take_str(self._lib, self._lib.tft_manager_health(self._handle))
+            or "{}"
+        )
 
     @property
     def port(self) -> int:
@@ -592,11 +646,26 @@ class LighthouseClient:
         resp = self._client.call("quorum", {"requester": member._to_json()}, timeout)
         return Quorum._from_json(resp["quorum"])
 
-    def heartbeat(self, replica_id: str, timeout: "float | timedelta" = 5.0) -> None:
-        self._client.call("heartbeat", {"replica_id": replica_id}, timeout)
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout: "float | timedelta" = 5.0,
+        telemetry: Optional[dict] = None,
+    ) -> dict:
+        """Beat once; optionally carries a healthwatch telemetry payload.
+        Returns the lighthouse's response (``health`` key: this replica's
+        health summary)."""
+        params: Dict = {"replica_id": replica_id}
+        if telemetry is not None:
+            params["telemetry"] = telemetry
+        return self._client.call("heartbeat", params, timeout)
 
     def status(self, timeout: "float | timedelta" = 5.0) -> dict:
         return self._client.call("status", {}, timeout)
+
+    def health(self, timeout: "float | timedelta" = 5.0) -> dict:
+        """Full healthwatch ledger dump (same payload as GET /health)."""
+        return self._client.call("health", {}, timeout)
 
 
 class ManagerClient:
@@ -776,3 +845,44 @@ def compute_quorum_results(
     result_s = _take_str(lib, result)
     _raise_for_status(status, err_s, "compute_quorum_results failed")
     return QuorumResult._from_json(json.loads(result_s))
+
+
+def health_scores(windows: "Dict[str, list]", opts: dict) -> "Dict[str, float]":
+    """Run the NATIVE straggler scoring on synthetic windows.
+
+    Parity hook for tests: torchft_tpu/healthwatch.py carries the canonical
+    Python implementation and tests pin the native one to it.
+    """
+    lib = _load()
+    result = ctypes.c_char_p()
+    err = ctypes.c_char_p()
+    status = lib.tft_health_scores(
+        json.dumps(windows).encode(), json.dumps(opts).encode(),
+        ctypes.byref(result), ctypes.byref(err),
+    )
+    err_s = _take_str(lib, err)
+    result_s = _take_str(lib, result)
+    _raise_for_status(status, err_s, "health_scores failed")
+    return json.loads(result_s)
+
+
+def health_replay(script: list, opts: dict) -> dict:
+    """Replay a scripted beat/tick sequence through the NATIVE health
+    ledger on a synthetic clock; returns ``{"events", "ledger", "excluded"}``.
+
+    ``script`` entries: ``{"t_ms": N, "replica_id": ..., "telemetry":
+    {...}?}`` for beats, ``{"t_ms": N, "tick": true}`` for ticks. ``opts``
+    is HealthOpts fields plus ``heartbeat_timeout_ms`` / ``min_replicas``.
+    Parity hook for tests against the Python :class:`HealthLedger`.
+    """
+    lib = _load()
+    result = ctypes.c_char_p()
+    err = ctypes.c_char_p()
+    status = lib.tft_health_replay(
+        json.dumps(script).encode(), json.dumps(opts).encode(),
+        ctypes.byref(result), ctypes.byref(err),
+    )
+    err_s = _take_str(lib, err)
+    result_s = _take_str(lib, result)
+    _raise_for_status(status, err_s, "health_replay failed")
+    return json.loads(result_s)

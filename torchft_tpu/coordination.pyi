@@ -80,6 +80,7 @@ class LighthouseServer:
         join_timeout_ms: int = ...,
         quorum_tick_ms: int = ...,
         heartbeat_timeout_ms: int = ...,
+        health: Optional[dict] = ...,
     ) -> None: ...
     def address(self) -> str: ...
     @property
@@ -102,6 +103,8 @@ class ManagerServer:
     def address(self) -> str: ...
     @property
     def port(self) -> int: ...
+    def publish_telemetry(self, telemetry: dict) -> None: ...
+    def health(self) -> dict: ...
     def shutdown(self) -> None: ...
 
 class KvStoreServer:
@@ -125,8 +128,14 @@ class LighthouseClient:
         data: Optional[Dict] = ...,
         commit_failures: int = ...,
     ) -> Quorum: ...
-    def heartbeat(self, replica_id: str, timeout: _Timeout = ...) -> None: ...
+    def heartbeat(
+        self,
+        replica_id: str,
+        timeout: _Timeout = ...,
+        telemetry: Optional[dict] = ...,
+    ) -> dict: ...
     def status(self, timeout: _Timeout = ...) -> dict: ...
+    def health(self, timeout: _Timeout = ...) -> dict: ...
 
 class ManagerClient:
     def __init__(self, addr: str, connect_timeout: _Timeout = ...) -> None: ...
@@ -152,3 +161,5 @@ def quorum_compute(state: dict, opts: dict) -> dict: ...
 def compute_quorum_results(
     replica_id: str, group_rank: int, quorum: dict, init_sync: bool = ...
 ) -> QuorumResult: ...
+def health_scores(windows: Dict[str, list], opts: dict) -> Dict[str, float]: ...
+def health_replay(script: list, opts: dict) -> dict: ...

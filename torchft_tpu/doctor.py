@@ -8,7 +8,10 @@ WEDGED instead of hanging the doctor — the failure mode bench.py's
 `_probe_accelerator` exists for), the virtual multi-device CPU mesh works
 (what tests and dryruns rely on), a lighthouse round-trip completes, the
 ``TORCHFT_RETRY_*`` env knobs are sane (parseable, and the worst-case
-backoff budget ordered below the quorum timeout), and a loopback
+backoff budget ordered below the quorum timeout), the ``TORCHFT_HEALTH_*``
+healthwatch knobs validate (eject above warn, probation window wide enough
+for probe heartbeats to land) with a loopback ``GET /health`` probe of the
+lighthouse ledger endpoint, and a loopback
 live-heal round-trip through the default HTTP transport lands in place —
 with one mid-transfer connection drop injected so the ranged-resume path
 (the tier-1 recovery behavior a rejoining replica depends on) is
@@ -153,6 +156,80 @@ def check_retry_env() -> Result:
     return True, detail
 
 
+def check_health_env() -> Result:
+    """TORCHFT_HEALTH_* env sanity: the knobs parse and validate (which
+    enforces eject_z > warn_z — ordered thresholds are what makes warn an
+    early warning), and the probation window is long enough to actually
+    observe recovery: readmission needs probe heartbeats to land INSIDE
+    the window, so probation_ms must comfortably exceed the heartbeat
+    interval or a readmitted replica is judged on zero samples."""
+    try:
+        from torchft_tpu.healthwatch import HealthConfig
+
+        config = HealthConfig.from_env()
+    except ValueError as e:
+        return False, f"TORCHFT_HEALTH_* env invalid: {e}"
+    detail = (
+        f"mode={config.mode} warn_z={config.warn_z} eject_z={config.eject_z} "
+        f"eject_steps={config.eject_steps} probation_ms={config.probation_ms}"
+    )
+    if config.mode == "off":
+        return None, f"healthwatch disabled; {detail}"
+    # the default Manager heartbeat interval (manager.py) — the cadence
+    # probe beats arrive at during probation
+    heartbeat_ms = float(os.environ.get("TORCHFT_HEARTBEAT_INTERVAL_MS", "100"))
+    if config.probation_ms <= heartbeat_ms:
+        return False, (
+            f"TORCHFT_HEALTH_PROBATION_MS={config.probation_ms} <= heartbeat "
+            f"interval {heartbeat_ms:.0f}ms — the probation window closes "
+            "before a single probe heartbeat lands; raise it"
+        )
+    if config.probation_ms < heartbeat_ms * config.probe_ok:
+        return None, (
+            f"probation_ms={config.probation_ms} < heartbeat interval × "
+            f"probe_ok ({heartbeat_ms:.0f}×{config.probe_ok}) — readmission "
+            "may need several windows; consider raising it"
+        )
+    return True, detail
+
+
+def check_health_endpoint() -> Result:
+    """Loopback /health probe: a lighthouse with the healthwatch ledger
+    enabled serves the JSON an operator's dashboard would scrape, and the
+    payload reflects a heartbeat it just ingested."""
+    try:
+        import json as _json
+        import urllib.request
+
+        from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+            health={"mode": "observe"},
+        )
+        try:
+            client = LighthouseClient(f"127.0.0.1:{lh.port}", connect_timeout=5.0)
+            client.heartbeat(
+                "doctor", timeout=5.0,
+                telemetry={"step": 1, "step_s": 0.1, "wire_s": 0.01},
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{lh.port}/health", timeout=5.0
+            ) as resp:
+                payload = _json.loads(resp.read().decode())
+        finally:
+            lh.shutdown()
+        if "doctor" not in payload.get("replicas", {}):
+            return False, f"/health missing the beating replica: {payload}"
+        return True, (
+            f"/health serves mode={payload.get('mode')} "
+            f"({len(payload.get('replicas', {}))} replica tracked)"
+        )
+    except Exception as e:  # noqa: BLE001
+        return False, f"/health probe failed: {e}"
+
+
 def check_heal_roundtrip() -> Result:
     """Loopback live-heal: send a small composite through the default
     HTTPTransport and receive it in place — the tier-1 recovery path a
@@ -219,6 +296,8 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("virtual-mesh", check_virtual_mesh),
     ("lighthouse", check_lighthouse_roundtrip),
     ("retry-env", check_retry_env),
+    ("health-env", check_health_env),
+    ("health-http", check_health_endpoint),
     ("heal", check_heal_roundtrip),
 ]
 

@@ -25,6 +25,12 @@ checkpoint transport reports them and ``rpc_retry`` per retried
 control-plane call, and dumps with ``reason="heal_exhausted"`` when a heal
 runs out of candidate peers — so the dump contains the full retry/failover
 sequence that led to the abort.
+
+Healthwatch transitions ride it too: the Manager records
+``straggler_warn`` / ``eject`` / ``readmit`` / ``recovered`` as it observes
+its own state change in heartbeat health summaries (manager.py
+``_observe_health``), so a postmortem dump shows whether the replica was
+warned or proactively excluded before the failure being debugged.
 """
 
 from __future__ import annotations

@@ -1,0 +1,443 @@
+"""Healthwatch: straggler scoring + escalation policy for the health plane.
+
+The quorum's health test was binary — a heartbeat is fresh or stale
+(native/quorum.cc) — so a slow-but-alive replica (throttled TPU, degraded
+NIC, noisy neighbor) silently drags every synchronous step: the managed
+allreduce is a barrier, so the whole quorum runs at the straggler's pace.
+Healthwatch turns the per-step telemetry the Manager already collects into
+step-granular membership decisions:
+
+1. The Manager publishes per-step telemetry (``step``, ``step_s``,
+   ``wire_s``, heal/retry counters) which piggybacks on the existing
+   heartbeat thread (no new RPC).
+2. The lighthouse's native health ledger keeps a rolling window of
+   compute-time samples per replica and scores each replica against the
+   quorum median (:func:`straggler_scores`).
+3. A policy engine escalates ``ok -> warn -> ejected -> probation -> ok``
+   (:class:`HealthLedger`); an ejected replica enters the exclusion set the
+   quorum computation consults, so ejection is just a step-granular
+   membership change through the existing shrink path.
+
+This module is the **canonical spec**: the native ledger
+(native/healthwatch.cc) mirrors the math and state machine here, and
+tests/test_healthwatch.py drives the same synthetic inputs through both
+(via :func:`torchft_tpu.coordination.health_scores` /
+:func:`~torchft_tpu.coordination.health_replay`) to pin them together.
+
+Scoring
+-------
+Per replica, the robust statistic is the median of its window of
+``step_s - wire_s`` samples (compute time: wall time equalizes across the
+quorum because of the allreduce barrier — the straggler is the replica
+with high compute and low wire wait). Across replicas the score is a
+modified z-score: ``(x - median) / scale`` where ``scale`` is the MAD
+rescaled by 0.6745, floored at ``rel_floor * median`` because the MAD
+degenerates to zero on a homogeneous fleet (the straggler is the only
+deviation, so the median of deviations vanishes). Only positive deviations
+score — a fast replica is not a straggler. Fewer than two scorable
+replicas -> no peer group -> all scores zero, which is also why 1- and
+2-replica fleets can never reach the eject threshold organically.
+
+Env knobs (all ``TORCHFT_HEALTH_*``)
+------------------------------------
+==========================  ========= =========================================
+``TORCHFT_HEALTH_MODE``     observe   ``off`` | ``observe`` (score + report,
+                                      never eject) | ``eject`` (opt-in)
+``TORCHFT_HEALTH_WINDOW``   32        samples kept per replica
+``TORCHFT_HEALTH_MIN_SAMPLES`` 5      warmup grace before a replica is scored
+``TORCHFT_HEALTH_WARN_Z``   3.0       score above this -> warn
+``TORCHFT_HEALTH_EJECT_Z``  6.0       score above this counts an eject strike
+``TORCHFT_HEALTH_EJECT_STEPS`` 3      consecutive strikes before ejection
+``TORCHFT_HEALTH_PROBATION_MS`` 10000 continuous fresh beats -> readmission
+``TORCHFT_HEALTH_PROBE_OK`` 3         clean scored samples to leave probation
+``TORCHFT_HEALTH_REL_FLOOR`` 0.05     scale floor as a fraction of the median
+==========================  ========= =========================================
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+__all__ = [
+    "HealthConfig",
+    "HealthState",
+    "HealthLedger",
+    "median",
+    "mad",
+    "straggler_scores",
+]
+
+_MODES = ("off", "observe", "eject")
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Healthwatch policy knobs; see the module docstring for semantics."""
+
+    mode: str = "observe"
+    window: int = 32
+    min_samples: int = 5
+    warn_z: float = 3.0
+    eject_z: float = 6.0
+    eject_steps: int = 3
+    probation_ms: int = 10000
+    probe_ok: int = 3
+    rel_floor: float = 0.05
+
+    @staticmethod
+    def from_env() -> "HealthConfig":
+        """Build from ``TORCHFT_HEALTH_*``; raises ValueError on junk."""
+        defaults = HealthConfig()
+
+        def _get(name: str, cast: Any, default: Any) -> Any:
+            raw = os.environ.get(name)
+            if raw is None or raw == "":
+                return default
+            try:
+                return cast(raw)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"{name}={raw!r}: {e}") from e
+
+        cfg = HealthConfig(
+            mode=_get("TORCHFT_HEALTH_MODE", str, defaults.mode).lower(),
+            window=_get("TORCHFT_HEALTH_WINDOW", int, defaults.window),
+            min_samples=_get(
+                "TORCHFT_HEALTH_MIN_SAMPLES", int, defaults.min_samples
+            ),
+            warn_z=_get("TORCHFT_HEALTH_WARN_Z", float, defaults.warn_z),
+            eject_z=_get("TORCHFT_HEALTH_EJECT_Z", float, defaults.eject_z),
+            eject_steps=_get(
+                "TORCHFT_HEALTH_EJECT_STEPS", int, defaults.eject_steps
+            ),
+            probation_ms=_get(
+                "TORCHFT_HEALTH_PROBATION_MS", int, defaults.probation_ms
+            ),
+            probe_ok=_get("TORCHFT_HEALTH_PROBE_OK", int, defaults.probe_ok),
+            rel_floor=_get(
+                "TORCHFT_HEALTH_REL_FLOOR", float, defaults.rel_floor
+            ),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.mode not in _MODES:
+            raise ValueError(
+                f"TORCHFT_HEALTH_MODE={self.mode!r}: must be one of {_MODES}"
+            )
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.min_samples < 1:
+            raise ValueError(
+                f"min_samples must be >= 1, got {self.min_samples}"
+            )
+        if self.eject_z <= self.warn_z:
+            raise ValueError(
+                f"eject_z ({self.eject_z}) must be > warn_z ({self.warn_z}):"
+                " an eject threshold at or below warn skips the warning"
+                " escalation entirely"
+            )
+        if self.eject_steps < 1:
+            raise ValueError(
+                f"eject_steps must be >= 1, got {self.eject_steps}"
+            )
+        if self.probation_ms < 0:
+            raise ValueError(
+                f"probation_ms must be >= 0, got {self.probation_ms}"
+            )
+        if self.rel_floor <= 0:
+            raise ValueError(
+                f"rel_floor must be > 0, got {self.rel_floor}"
+            )
+
+    def to_json(self) -> Dict[str, Any]:
+        """The dict shape the native lighthouse ctor takes as "health"."""
+        return {
+            "mode": self.mode,
+            "window": self.window,
+            "min_samples": self.min_samples,
+            "warn_z": self.warn_z,
+            "eject_z": self.eject_z,
+            "eject_steps": self.eject_steps,
+            "probation_ms": self.probation_ms,
+            "probe_ok": self.probe_ok,
+            "rel_floor": self.rel_floor,
+        }
+
+
+def median(values: Sequence[float]) -> float:
+    """Median; 0.0 on empty input (matches the native ledger)."""
+    if not values:
+        return 0.0
+    v = sorted(values)
+    n = len(v)
+    if n % 2 == 1:
+        return float(v[n // 2])
+    return 0.5 * (v[n // 2 - 1] + v[n // 2])
+
+
+def mad(values: Sequence[float]) -> float:
+    """Median absolute deviation around the median."""
+    m = median(values)
+    return median([abs(x - m) for x in values])
+
+
+def straggler_scores(
+    windows: Mapping[str, Sequence[float]], config: HealthConfig
+) -> Dict[str, float]:
+    """Quorum-relative straggler score per replica.
+
+    ``windows`` maps replica_id -> rolling window of compute-time samples.
+    Replicas with fewer than ``config.min_samples`` samples are in their
+    warmup grace: scored 0 and excluded from the peer statistics. Fewer
+    than two scorable replicas -> all zeros (no peer group).
+    """
+    scores: Dict[str, float] = {rid: 0.0 for rid in windows}
+    stats = {
+        rid: median(w)
+        for rid, w in windows.items()
+        if len(w) >= config.min_samples
+    }
+    if len(stats) < 2:
+        return scores
+    xs = list(stats.values())
+    med = median(xs)
+    scale = max(
+        mad(xs) / 0.6745,
+        config.rel_floor * max(med, 0.0),
+        1e-9,
+    )
+    for rid, x in stats.items():
+        scores[rid] = max(0.0, x - med) / scale  # only SLOW is unhealthy
+    return scores
+
+
+class HealthState(IntEnum):
+    OK = 0
+    WARN = 1
+    EJECTED = 2
+    PROBATION = 3
+
+
+@dataclass
+class _Replica:
+    window: List[float] = field(default_factory=list)
+    last_step: int = -1
+    last_step_s: float = 0.0
+    last_wire_s: float = 0.0
+    score: float = 0.0
+    state: HealthState = HealthState.OK
+    strikes: int = 0
+    probes_ok: int = 0
+    ejections: int = 0
+    readmissions: int = 0
+    samples_total: int = 0
+    ejected_at_ms: float = 0.0
+    last_beat_ms: Optional[float] = None
+
+
+class HealthLedger:
+    """Pure-Python mirror of the native ledger (native/healthwatch.cc).
+
+    Time is an explicit ``now_ms`` argument so tests replay deterministic
+    scripts; the native side is driven through the same scripts via
+    ``coordination.health_replay`` and must emit the same events.
+    """
+
+    def __init__(
+        self,
+        config: HealthConfig,
+        heartbeat_timeout_ms: int = 5000,
+        min_replicas: int = 1,
+    ) -> None:
+        self.config = config
+        self.heartbeat_timeout_ms = heartbeat_timeout_ms
+        self.min_replicas = min_replicas
+        self._replicas: Dict[str, _Replica] = {}
+        self._excluded: set = set()
+
+    @property
+    def exclusions(self) -> "set[str]":
+        return set(self._excluded)
+
+    def on_heartbeat(
+        self,
+        replica_id: str,
+        telemetry: Optional[Mapping[str, Any]],
+        now_ms: float,
+    ) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        if self.config.mode == "off":
+            return events
+        rh = self._replicas.setdefault(replica_id, _Replica())
+        # Probation demands CONTINUOUS fresh beats: a gap restarts the clock.
+        if (
+            rh.state is HealthState.EJECTED
+            and rh.last_beat_ms is not None
+            and now_ms - rh.last_beat_ms > self.heartbeat_timeout_ms
+        ):
+            rh.ejected_at_ms = now_ms
+        rh.last_beat_ms = now_ms
+
+        if (
+            telemetry is not None
+            and "step" in telemetry
+            and rh.state is not HealthState.EJECTED
+        ):
+            step = int(telemetry["step"])
+            if step > rh.last_step:  # dedup: the beat loop re-sends latest
+                rh.last_step = step
+                step_s = float(telemetry.get("step_s", 0.0))
+                wire_s = float(telemetry.get("wire_s", 0.0))
+                rh.last_step_s = step_s
+                rh.last_wire_s = wire_s
+                rh.window.append(max(step_s - wire_s, 0.0))
+                del rh.window[: -self.config.window]
+                rh.samples_total += 1
+                self._evaluate(replica_id, now_ms, events)
+        return events
+
+    def tick(
+        self, now_ms: float, prune_after_ms: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        if self.config.mode == "off":
+            return events
+        prune = (
+            prune_after_ms
+            if prune_after_ms is not None
+            else 10 * self.heartbeat_timeout_ms
+        )
+        for rid in list(self._replicas):
+            rh = self._replicas[rid]
+            beat = rh.last_beat_ms if rh.last_beat_ms is not None else -prune
+            if now_ms - beat > prune:
+                self._excluded.discard(rid)
+                del self._replicas[rid]
+                continue
+            if (
+                rh.state is HealthState.EJECTED
+                and now_ms - rh.ejected_at_ms >= self.config.probation_ms
+                and now_ms - beat < self.heartbeat_timeout_ms
+            ):
+                rh.state = HealthState.PROBATION
+                rh.readmissions += 1
+                rh.probes_ok = 0
+                self._excluded.discard(rid)
+                events.append(
+                    {
+                        "kind": "readmit",
+                        "replica_id": rid,
+                        "readmissions": rh.readmissions,
+                    }
+                )
+        return events
+
+    def state_of(self, replica_id: str) -> HealthState:
+        rh = self._replicas.get(replica_id)
+        return rh.state if rh else HealthState.OK
+
+    def replica(self, replica_id: str) -> Optional[_Replica]:
+        return self._replicas.get(replica_id)
+
+    # -- internals --------------------------------------------------------
+
+    def _can_eject(self, now_ms: float) -> bool:
+        live = sum(
+            1
+            for rid, rh in self._replicas.items()
+            if rid not in self._excluded
+            and rh.last_beat_ms is not None
+            and now_ms - rh.last_beat_ms < self.heartbeat_timeout_ms
+        )
+        return live - 1 >= self.min_replicas
+
+    def _eject(
+        self, rid: str, rh: _Replica, now_ms: float, events: List[Dict]
+    ) -> None:
+        rh.state = HealthState.EJECTED
+        rh.ejections += 1
+        rh.strikes = 0
+        rh.probes_ok = 0
+        rh.ejected_at_ms = now_ms
+        # last_step is kept: the beat loop re-sends the last pre-ejection
+        # (dilated) telemetry until the replica actually steps again
+        rh.window = []
+        self._excluded.add(rid)
+        events.append(
+            {
+                "kind": "eject",
+                "replica_id": rid,
+                "score": rh.score,
+                "ejections": rh.ejections,
+            }
+        )
+
+    def _evaluate(
+        self, rid: str, now_ms: float, events: List[Dict]
+    ) -> None:
+        cfg = self.config
+        windows = {
+            r: rh.window
+            for r, rh in self._replicas.items()
+            if r not in self._excluded
+        }
+        scores = straggler_scores(windows, cfg)
+        for r, rh in self._replicas.items():
+            if r in scores:
+                rh.score = scores[r]
+
+        rh = self._replicas[rid]
+        s = rh.score
+
+        if rh.state is HealthState.PROBATION:
+            if s > cfg.eject_z:  # one strike in probation: straight back out
+                if cfg.mode == "eject" and self._can_eject(now_ms):
+                    self._eject(rid, rh, now_ms, events)
+                return
+            if len(rh.window) < cfg.min_samples:
+                return  # unscored warmup samples say nothing about recovery
+            rh.probes_ok += 1
+            if rh.probes_ok >= cfg.probe_ok:
+                rh.state = (
+                    HealthState.WARN if s > cfg.warn_z else HealthState.OK
+                )
+                rh.probes_ok = 0
+            return
+
+        rh.strikes = rh.strikes + 1 if s > cfg.eject_z else 0
+
+        if s > cfg.warn_z and rh.state is HealthState.OK:
+            rh.state = HealthState.WARN
+            events.append(
+                {
+                    "kind": "straggler_warn",
+                    "replica_id": rid,
+                    "score": s,
+                    "warn_z": cfg.warn_z,
+                }
+            )
+        elif s <= cfg.warn_z and rh.state is HealthState.WARN:
+            rh.state = HealthState.OK
+
+        if rh.strikes >= cfg.eject_steps:
+            if cfg.mode == "eject" and self._can_eject(now_ms):
+                self._eject(rid, rh, now_ms, events)
+            else:
+                events.append(
+                    {
+                        "kind": "straggler_warn",
+                        "replica_id": rid,
+                        "score": s,
+                        "would_eject": True,
+                        "reason": (
+                            "min_replicas floor"
+                            if cfg.mode == "eject"
+                            else f"mode={cfg.mode}"
+                        ),
+                    }
+                )
+                rh.strikes = 0

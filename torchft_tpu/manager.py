@@ -48,6 +48,7 @@ from torchft_tpu.futures import future_timeout
 from torchft_tpu.observability import (
     ALLREDUCE_PIPELINE_PHASE,
     COMMIT_EVENTS,
+    HEALTH_EVENTS,
     TIMING_EVENTS,
     emit_event_async,
     log_error_event,
@@ -379,6 +380,21 @@ class Manager:
         # so "the step got slower" is attributable to a named RPC.
         self._client.set_retry_observer(self._on_rpc_retry)
         self._vote_client.set_retry_observer(self._on_rpc_retry)
+        # healthwatch: the group leader piggybacks per-step telemetry on
+        # its heartbeat thread (publish_telemetry) and reads the
+        # lighthouse's health summary back off the same round-trip. The
+        # summary's cumulative counters and latest state ride timings();
+        # state TRANSITIONS additionally emit torchft_health events and
+        # flight-recorder breadcrumbs (_publish_step_telemetry).
+        for _counter in ("health_state", "straggler_score", "ejections", "readmissions"):
+            self._timings[_counter] = 0.0
+        self._telemetry_transform: Optional[
+            Callable[[Dict[str, Any]], Dict[str, Any]]
+        ] = None
+        self._last_health_state: Optional[str] = None
+        self._last_commit_t: Optional[float] = None
+        self._last_vote_committed = False
+        self._telemetry_quorum_id: Optional[int] = None
         self._participating_replica_rank: Optional[int] = None
         # last seen PG backend generation (see _sync_device_world)
         self._device_world_epoch = getattr(pg, "device_world_epoch", None)
@@ -1676,9 +1692,137 @@ class Manager:
         plus same-source retries), ``heal_failovers`` (mid-heal switches to
         a fallback peer), ``rpc_retries`` (retried control-plane calls),
         and ``chunk_crc_failures`` (chunks refetched after an integrity
-        mismatch)."""
+        mismatch).
+
+        When healthwatch telemetry is enabled (group leader talking to a
+        lighthouse with ``TORCHFT_HEALTH_MODE`` != ``off``) it also
+        mirrors the lighthouse's latest health summary for THIS replica:
+        ``health_state`` (0=ok 1=warn 2=ejected 3=probation),
+        ``straggler_score`` (quorum-relative modified z-score), and the
+        cumulative ``ejections`` / ``readmissions`` counts. All four are
+        seeded to 0.0 at construction."""
         with self._metrics_lock:
             return dict(self._timings)
+
+    # -------------------------------------------------------- healthwatch
+    def set_telemetry_transform(
+        self, fn: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]]
+    ) -> None:
+        """Install a hook applied to the per-step telemetry dict right
+        before it is published to the lighthouse (None to clear). Exists
+        for fault injection: ``EventInjector.slow_replica`` dilates the
+        reported ``step_s`` so straggler ejection can be exercised without
+        actually slowing a test replica down."""
+        self._telemetry_transform = fn
+
+    def _publish_step_telemetry(self, committed: bool = True) -> None:
+        """Group leader only: stage this step's telemetry for the C++
+        heartbeat thread (the lighthouse ingests it into the health
+        ledger) and fold the summary the previous heartbeat brought back
+        into timings() / the ``torchft_health`` stream.
+
+        ``step_s`` is the wall clock between consecutive commit votes —
+        the only boundary every replica crosses exactly once per step.
+        ``wire_s`` is the most recent allreduce wire time, so the
+        lighthouse can score on COMPUTE time (step minus wire): wall time
+        equalizes across a quorum because the allreduce is a barrier, and
+        the straggler is the replica whose compute share grew.
+
+        A sample is published only when THIS vote and the PREVIOUS vote
+        both committed AND both ran under the same quorum_id: a span
+        touching a failed vote measures quorum retries, healing, or a
+        discarded step, and a span crossing a reconfiguration measures the
+        reconfiguration itself — neither is training pace. The quorum_id
+        leg is what makes probationary readmission survivable: an excluded
+        replica casts no votes at all while its quorum thread spins in the
+        re-subscribe loop, so its first post-readmit interval bridges two
+        committed votes that straddle the whole exclusion, and scoring
+        that one multi-second sample would re-eject it on the spot.
+
+        Must never raise — telemetry is advisory and this sits on the
+        commit path."""
+        if self._manager is None:
+            return
+        now = time.perf_counter()
+        last, self._last_commit_t = self._last_commit_t, now
+        prev_committed = self._last_vote_committed
+        self._last_vote_committed = committed
+        same_quorum = self._quorum_id == self._telemetry_quorum_id
+        self._telemetry_quorum_id = self._quorum_id
+        try:
+            if last is not None and committed and prev_committed and same_quorum:
+                with self._metrics_lock:
+                    wire_s = self._timings.get(
+                        "allreduce_wire_s", self._timings.get("allreduce_s", 0.0)
+                    )
+                    heal_attempts = self._timings.get("heal_attempts", 0.0)
+                    rpc_retries = self._timings.get("rpc_retries", 0.0)
+                telemetry: Dict[str, Any] = {
+                    "step": self._step,
+                    "step_s": now - last,
+                    "wire_s": wire_s,
+                    "heal_attempts": heal_attempts,
+                    "rpc_retries": rpc_retries,
+                }
+                if self._telemetry_transform is not None:
+                    telemetry = self._telemetry_transform(telemetry)
+                self._manager.publish_telemetry(telemetry)
+            self._observe_health(self._manager.health())
+        except Exception:  # noqa: BLE001 — advisory plane, commit path
+            self._logger.exception("failed to publish step telemetry")
+
+    def _observe_health(self, summary: Dict[str, Any]) -> None:
+        """Fold a heartbeat health summary into timings() and emit a
+        ``torchft_health`` event (plus a flight-recorder breadcrumb) on
+        every state TRANSITION: ``straggler_warn`` on entering warn,
+        ``eject`` on entering ejected, ``readmit`` on entering probation
+        (the lighthouse lifts the exclusion at that edge), ``recovered``
+        on returning to ok."""
+        state = summary.get("state")
+        if not state:
+            return
+        with self._metrics_lock:
+            self._timings["health_state"] = float(summary.get("state_code", 0))
+            self._timings["straggler_score"] = float(summary.get("score", 0.0))
+            self._timings["ejections"] = float(summary.get("ejections", 0))
+            self._timings["readmissions"] = float(summary.get("readmissions", 0))
+        prev, self._last_health_state = self._last_health_state, state
+        if prev == state or prev is None and state == "ok":
+            return
+        kind = {
+            "warn": "straggler_warn",
+            "ejected": "eject",
+            "probation": "readmit",
+            "ok": "recovered",
+        }.get(state, state)
+        emit_event_async(
+            HEALTH_EVENTS,
+            replica_id=self._replica_id,
+            group_rank=self._group_rank,
+            step=self._step,
+            quorum_id=self._quorum_id,
+            kind=kind,
+            state=state,
+            prev_state=prev,
+            score=summary.get("score", 0.0),
+            ejections=summary.get("ejections", 0),
+            readmissions=summary.get("readmissions", 0),
+        )
+        self._logger.warning(
+            f"healthwatch: {kind} (state {prev} -> {state}, "
+            f"score={summary.get('score', 0.0)})"
+        )
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            kind,
+            state=state,
+            prev_state=prev,
+            score=summary.get("score", 0.0),
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+        )
 
     def _log_timing_snapshot(self, phase: str) -> None:
         try:
@@ -1876,6 +2020,10 @@ class Manager:
             "bookkeeping_s",
             max(0.0, time.perf_counter() - t_begin - rpc_s - join_s),
         )
+        # stage telemetry for the heartbeat thread + fold back the health
+        # summary it last brought home; pure bookkeeping (one dict build
+        # and two lock hops), no RPC on this path
+        self._publish_step_telemetry(committed=should_commit)
         return should_commit
 
     # -------------------------------------------------------- introspection

@@ -14,7 +14,14 @@ Three structured event streams mirror the reference's loggers:
   splits (``allreduce_pack_s`` / ``allreduce_wire_s`` /
   ``allreduce_unpack_s``, ``allreduce_buckets``) plus
   ``overlap_efficiency`` — the fraction of wire time hidden behind other
-  buckets' pipeline stages.
+  buckets' pipeline stages;
+- ``torchft_health`` — healthwatch lifecycle transitions observed by the
+  Manager in heartbeat health summaries: ``straggler_warn`` when the
+  lighthouse's quorum-relative straggler score crosses the warn
+  threshold, ``eject`` when a replica is proactively excluded from the
+  next quorum, ``readmit`` when a probationary replica rejoins. Each
+  record carries the score, state, and cumulative ejection/readmission
+  counts (see healthwatch.py).
 
 Records are JSON-serialised into the standard ``logging`` stream, and — when
 ``TORCHFT_USE_OTEL=1`` and the ``opentelemetry`` packages are importable —
@@ -50,6 +57,10 @@ ERROR_EVENTS = "torchft_errors"
 # allreduce_pack_s/wire_s/unpack_s, allreduce_buckets, overlap_efficiency)
 TIMING_EVENTS = "torchft_timings"
 ALLREDUCE_PIPELINE_PHASE = "allreduce_pipeline"
+# healthwatch lifecycle transitions (straggler_warn / eject / readmit) as
+# the Manager observes them in heartbeat health summaries — the replica's
+# own view of the lighthouse health ledger (healthwatch.py)
+HEALTH_EVENTS = "torchft_health"
 
 _otel_providers: Dict[str, Any] = {}
 
@@ -151,6 +162,10 @@ def log_error_event(**fields: Any) -> None:
 
 def log_timing_event(**fields: Any) -> None:
     get_event_logger(TIMING_EVENTS).log(**fields)
+
+
+def log_health_event(**fields: Any) -> None:
+    get_event_logger(HEALTH_EVENTS).log(**fields)
 
 
 class EventDrain:

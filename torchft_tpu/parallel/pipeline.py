@@ -121,7 +121,8 @@ def make_pp_llama_loss(cfg: Any, mesh: Mesh, num_microbatches: Optional[int] = N
     targets, per-stage activation residency without remat would hit the HBM
     ceiling.
     """
-    from jax import shard_map
+    from torchft_tpu.utils import import_shard_map
+    shard_map = import_shard_map()
 
     from torchft_tpu.models.llama import _rmsnorm, make_llama_layer_body
     from torchft_tpu.models.remat import remat_wrap

@@ -92,7 +92,8 @@ def make_sp_attention_fn(mesh: Mesh, kernel):
     strategies: ``kernel(q, k, v, cfg)`` runs per shard under the one
     (dp, fsdp) x sp x tp sharding contract, so ring and ulysses cannot
     drift apart on specs."""
-    from jax import shard_map
+    from torchft_tpu.utils import import_shard_map
+    shard_map = import_shard_map()
 
     qspec = P(("dp", "fsdp"), "sp", "tp", None)
 

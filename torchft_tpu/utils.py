@@ -170,3 +170,31 @@ def synchronize(tree: Any) -> Any:
     import jax
 
     return jax.block_until_ready(tree)
+
+
+def import_shard_map() -> Any:
+    """Return a ``shard_map`` callable that accepts the current-API kwargs.
+
+    Newer JAX exports ``jax.shard_map`` (with ``check_vma``); older
+    releases only ship ``jax.experimental.shard_map.shard_map`` (with
+    ``check_rep``). Every call site in this repo is written against the
+    current API, so the fallback wrapper translates ``check_vma`` ->
+    ``check_rep`` instead of each caller branching on the JAX version.
+    """
+    try:
+        from jax import shard_map  # jax >= 0.6
+
+        return shard_map
+    except ImportError:
+        pass
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def _shard_map_compat(f: Any, **kwargs: Any) -> Any:
+        if "check_vma" in kwargs:
+            kwargs.setdefault("check_rep", kwargs.pop("check_vma"))
+        return _legacy_shard_map(f, **kwargs)
+
+    return _shard_map_compat

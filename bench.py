@@ -368,6 +368,87 @@ def allreduce_pipeline(smoke: bool = False) -> None:
     }))
 
 
+def compressed_allreduce_metrics(size_mb: float = 64, leaves: int = 16,
+                                 cap_mb: float = 4, steps: int = 8,
+                                 warmup: int = 2) -> dict:
+    """Compressed vs uncompressed streamed managed allreduce: two live
+    replica groups exchange the same multi-bucket gradient tree through
+    real Managers once per compress mode (off / fp8 / int8) and report
+    per-mode stage splits plus effective wire bandwidth (logical
+    uncompressed bytes over measured wire seconds) and the fp8/int8
+    bandwidth ratios. CPU-pinned subprocess, same isolation policy as the
+    other FT rows."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    child = (
+        "from torchft_tpu.utils import force_virtual_cpu_devices\n"
+        "force_virtual_cpu_devices(1)\n"
+        "import sys, json\n"
+        f"sys.path.insert(0, {os.path.join(os.path.dirname(os.path.abspath(__file__)), 'benchmarks')!r})\n"
+        "from compressed_allreduce_bench import run\n"
+        f"print('COMPRESS ' + json.dumps(run(size_mb={size_mb}, "
+        f"leaves={leaves}, cap_mb={cap_mb}, steps={steps}, "
+        f"warmup={warmup})))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=560,
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("COMPRESS "):
+            return _json.loads(line[len("COMPRESS "):])
+    raise RuntimeError(
+        f"compressed-allreduce child failed rc={out.returncode}: "
+        f"{(out.stderr or out.stdout)[-300:]}"
+    )
+
+
+def compressed_allreduce(smoke: bool = False) -> None:
+    """``python bench.py --compressed-allreduce [--smoke]``: one JSON
+    line with per-mode (off/fp8/int8) stage splits, effective wire
+    bandwidth, and the fp8/int8 bandwidth ratios over the uncompressed
+    run. Smoke mode shrinks the payload and asserts every per-mode key is
+    present — the fast-tier CI gate (tests/test_bench_smoke.py) that
+    fails loudly if the compressed pipeline or its instrumentation
+    regresses. The full run's output is the committed
+    BENCH_COMPRESS.json."""
+    if smoke:
+        metrics = compressed_allreduce_metrics(
+            size_mb=8, leaves=8, cap_mb=2, steps=4, warmup=1
+        )
+    else:
+        metrics = compressed_allreduce_metrics()
+    for mode in ("off", "fp8", "int8"):
+        m = metrics.get("modes", {}).get(mode) or {}
+        missing = [k for k in ("step_s", "pack_s", "wire_s", "unpack_s",
+                               "buckets", "effective_wire_mb_s")
+                   if m.get(k) is None]
+        if missing:
+            raise RuntimeError(
+                f"compressed-allreduce: mode {mode} missing {missing}"
+            )
+        if not m["buckets"] > 1:
+            raise RuntimeError(
+                f"compressed-allreduce: mode {mode} ran a single bucket — "
+                "the plan no longer splits into per-bucket collectives"
+            )
+    if metrics.get("bandwidth_ratio_fp8") is None:
+        raise RuntimeError("compressed-allreduce: no fp8 bandwidth ratio")
+    print(json.dumps({
+        "metric": "fp8 effective wire bandwidth vs uncompressed "
+                  "(host loopback)",
+        "value": metrics["bandwidth_ratio_fp8"],
+        "unit": "x",
+        "vs_baseline": 1,
+        **metrics,
+    }))
+
+
 def ft_overhead(smoke: bool = False) -> None:
     """``python bench.py --ft-overhead [--smoke]``: one JSON line with
     ``ft_overhead_pct`` + the allreduce / vote-RPC / bookkeeping splits.
@@ -712,6 +793,10 @@ if __name__ == "__main__":
     if "--allreduce-pipeline" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         allreduce_pipeline(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--compressed-allreduce" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        compressed_allreduce(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--healthwatch" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke

@@ -148,6 +148,7 @@ def _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id) -> No
     import optax
 
     accum = max(1, getattr(args, "grad_accum", 1))
+    quantize = bool(getattr(args, "quantize", False))
     while manager.current_step() < args.steps:
         # synthetic batch, sharded per replica (DistributedSampler equivalent)
         x = jnp.asarray(rng.randn(args.batch_size, 32, 32, 3), jnp.float32)
@@ -160,6 +161,9 @@ def _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id) -> No
             # while the NEXT microbatch's grad_fn runs, so the wire rides
             # under compute. Allreduce is linear, so averaging the reduced
             # microbatch means equals reducing the accumulated mean.
+            # --quantize streams the same buckets fp8-compressed with
+            # error feedback — it no longer drops to the serial
+            # unbucketed path (tests/test_examples_smoke.py pins this).
             streams = []
             for k in range(accum):
                 if k > 0:
@@ -168,7 +172,11 @@ def _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id) -> No
                     )
                     y = jnp.asarray(rng.randint(0, 10, size=(args.batch_size,)))
                 loss, grads = grad_fn(state["params"], x, y)
-                streams.append(manager.allreduce_streamed(grads))
+                streams.append(
+                    manager.allreduce_streamed(
+                        grads, should_quantize=quantize
+                    )
+                )
             reduced_trees = [s.wait(timeout=60) for s in streams]
             reduced = jax.tree_util.tree_map(
                 lambda *vs: sum(jnp.asarray(v) for v in vs) / len(vs),
@@ -176,7 +184,9 @@ def _train_loop(args, manager, state, grad_fn, optimizer, rng, replica_id) -> No
             )
         else:
             loss, grads = grad_fn(state["params"], x, y)
-            reduced = manager.allreduce(grads).get_future().wait(timeout=60)
+            reduced = manager.allreduce(
+                grads, should_quantize=quantize
+            ).get_future().wait(timeout=60)
         if manager.should_commit():
             updates, new_opt_state = optimizer.update(
                 jax.tree_util.tree_map(jnp.asarray, reduced),
@@ -255,6 +265,10 @@ if __name__ == "__main__":
                         help="microbatches per step; >1 issues one STREAMED "
                              "allreduce per microbatch so bucket reduction "
                              "overlaps the next microbatch's grad_fn")
+    parser.add_argument("--quantize", action="store_true",
+                        help="stream gradient buckets fp8-compressed with "
+                             "error feedback (TORCHFT_COMPRESS picks the "
+                             "codec); composes with --grad-accum")
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--transport", choices=["http", "pg"], default="http",

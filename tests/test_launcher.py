@@ -104,10 +104,12 @@ def test_doctor_cli():
         if line.startswith(("ok", "warn", "FAIL"))
     }
     assert set(lines) == {"native", "accelerator", "virtual-mesh",
-                          "lighthouse", "heal"}, (
+                          "lighthouse", "retry-env", "health-env",
+                          "compress-env", "health-http", "heal"}, (
         proc.stdout + proc.stderr
     )
-    for check in ("native", "virtual-mesh", "lighthouse", "heal"):
+    for check in ("native", "virtual-mesh", "lighthouse", "retry-env",
+                  "health-env", "compress-env", "health-http", "heal"):
         assert lines[check] == "ok", proc.stdout
     if lines["accelerator"] != "FAIL":
         assert proc.returncode == 0, proc.stdout

@@ -556,3 +556,77 @@ class TestQuantizedDiLoCoConvergence:
                 q, b, rtol=0.1, atol=1e-3,
                 err_msg=f"sync cycle {step}: fp8 trajectory diverged",
             )
+
+
+class TestCompressedDiLoCoConvergence:
+    """``TORCHFT_COMPRESS=fp8`` routes the DiLoCo outer sync through the
+    Manager's compressed STREAMING pipeline (multi-leaf pseudograd tree ->
+    bucketed plan -> fp8 wire with per-bucket error feedback) — unlike
+    TestQuantizedDiLoCoConvergence above, whose single-leaf tree exercises
+    the monolithic allreduce_quantized fallback. The compressed trajectory
+    must track the uncompressed one to codec tolerance, and the residual
+    carry must not let error accumulate across sync cycles."""
+
+    SPREAD = np.linspace(1.0, 1.7, 8).astype(np.float32)
+
+    def _run(self, compress_env, monkeypatch):
+        if compress_env is None:
+            monkeypatch.delenv("TORCHFT_COMPRESS", raising=False)
+        else:
+            monkeypatch.setenv("TORCHFT_COMPRESS", compress_env)
+        lighthouse = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=5000,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        )
+
+        def replica(rid):
+            state = {"params": {
+                "w0": np.zeros(8, np.float32),
+                "w1": np.zeros(8, np.float32),
+            }}
+            manager = make_manager(
+                f"cconv_{compress_env}_{rid}", lighthouse, state
+            )
+            try:
+                diloco = DiLoCo(
+                    manager, state["params"], outer_tx=optax.sgd(1.0),
+                    sync_every=SYNC_EVERY,
+                    get_params=lambda: state["params"],
+                )
+                traj = []
+                for i in range(STEPS):
+                    drift = 0.1 * (rid + 1) * self.SPREAD
+                    state["params"] = {
+                        "w0": state["params"]["w0"] - drift,
+                        "w1": state["params"]["w1"] - 2.0 * drift,
+                    }
+                    state["params"] = diloco.step(state["params"])
+                    if (i + 1) % SYNC_EVERY == 0:  # post-sync snapshot
+                        traj.append(np.concatenate([
+                            np.asarray(state["params"]["w0"]),
+                            np.asarray(state["params"]["w1"]),
+                        ]).copy())
+                return traj
+            finally:
+                manager.shutdown(wait=False)
+
+        try:
+            results = run_threads([lambda r=r: replica(r) for r in range(2)])
+        finally:
+            lighthouse.shutdown()
+        for a, b in zip(*results):
+            np.testing.assert_array_equal(a, b)  # replicas agree post-sync
+        return results[0]
+
+    def test_fp8_stream_trajectory_tracks_uncompressed(self, monkeypatch):
+        base = self._run(None, monkeypatch)
+        comp = self._run("fp8", monkeypatch)
+        # compression must actually have engaged...
+        assert not all(np.array_equal(b, c) for b, c in zip(base, comp))
+        # ...and error feedback keeps every sync cycle at codec scale —
+        # no cross-cycle error accumulation
+        for step, (b, c) in enumerate(zip(base, comp)):
+            np.testing.assert_allclose(
+                c, b, rtol=0.1, atol=1e-3,
+                err_msg=f"sync cycle {step}: compressed trajectory diverged",
+            )

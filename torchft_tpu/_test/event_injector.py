@@ -8,7 +8,9 @@ plane: kill-the-heal-source-mid-transfer at chunk k / corrupt chunk k
 (armed on the serving transport via ``HTTPTransport.inject_chunk_fault``)
 and delayed/flaky control-plane RPCs (installed process-wide via
 ``coordination.set_rpc_fault_hook``), so the retry/failover machinery can
-be exercised deterministically. For the healthwatch plane,
+be exercised deterministically. ``kill_link`` severs one data-plane ring
+link mid-collective (armed via ``ProcessGroupHost.inject_link_fault``) so
+the compressed allreduce's in-collective re-route path is what recovers. For the healthwatch plane,
 ``slow_replica`` dilates the step time a replica REPORTS (installed as a
 ``Manager.set_telemetry_transform`` hook) so straggler scoring, proactive
 ejection, and probationary readmission run without real slowdowns.
@@ -39,6 +41,10 @@ class EventKind(Enum):
     # checkpoint transport — it fires when a HEALING PEER fetches from it
     HEAL_SOURCE_KILL = "heal_source_kill"
     HEAL_CHUNK_CORRUPT = "heal_chunk_corrupt"
+    # network-shaped, data plane: sever one ring link MID-COLLECTIVE so the
+    # compressed allreduce's in-collective failover (flood, re-form, finish
+    # as a re-routed slow step) is what recovers — not the step-discard path
+    KILL_LINK = "kill_link"
 
 
 @dataclass
@@ -47,6 +53,8 @@ class _Event:
     fired: bool = False
     chunk: int = 0
     times: int = 1  # serve count for the heal-source faults; -1 = every serve
+    src: int = 0  # kill_link endpoints (group ranks within the quorum)
+    dst: int = 0
 
 
 class EventInjector:
@@ -151,6 +159,29 @@ class EventInjector:
             )
         return self
 
+    def kill_link(
+        self, src: int, dst: int, step: int, at_hop: int = 0
+    ) -> "EventInjector":
+        """When either endpoint reaches ``step``, arm its host process
+        group to sever ring link ``(src, dst)`` from hop ``at_hop`` of the
+        next compressed collective. The fault fires *inside* the hop loop:
+        the rank that hits it floods a re-route signal, every rank restarts
+        under the retry policy, and the ring re-forms around the dead link
+        (falling back to an open chain where no ring exists, e.g. world=3)
+        — the step commits as a re-routed slow step, surfacing as a
+        ``collective_reroute`` count in ``Manager.timings()``.
+
+        ``src``/``dst`` are group ranks within the quorum. The event is
+        registered at BOTH endpoints because each rank checks faults
+        against its own PG's registry; arming both keeps the discovery
+        deterministic regardless of which side's hop runs first. The link
+        stays dead for the PG generation (``clear_link_faults`` to heal)."""
+        with self._lock:
+            ev = dict(src=int(src), dst=int(dst), chunk=int(at_hop))
+            self._events[(src, step)] = _Event(EventKind.KILL_LINK, **ev)
+            self._events[(dst, step)] = _Event(EventKind.KILL_LINK, **ev)
+        return self
+
     # --------------------------------------------------------- healthwatch
     def slow_replica(self, replica: int, factor: float) -> "EventInjector":
         """Make ``replica`` REPORT ``factor``× its true step time in the
@@ -247,6 +278,7 @@ class EventInjector:
             kind = event.kind
             chunk = event.chunk
             times = event.times
+            src, dst = event.src, event.dst
         if kind == EventKind.FAILURE:
             raise InjectedFailure(f"injected failure replica={replica} step={step}")
         if kind == EventKind.ALLREDUCE_FAILURE:
@@ -257,6 +289,12 @@ class EventInjector:
         if kind == EventKind.BARRIER:
             assert self._barrier is not None
             self._barrier.wait()
+        if kind == EventKind.KILL_LINK:
+            assert pg is not None and hasattr(pg, "inject_link_fault"), (
+                "kill_link needs a process group with inject_link_fault "
+                "(ProcessGroupHost or a wrapper around one)"
+            )
+            pg.inject_link_fault(src, dst, at_hop=chunk)
         if kind in (EventKind.HEAL_SOURCE_KILL, EventKind.HEAL_CHUNK_CORRUPT):
             assert transport is not None and hasattr(
                 transport, "inject_chunk_fault"

@@ -90,7 +90,13 @@ class BucketPlan:
     training loop over the same tree.
     """
 
-    __slots__ = ("groups", "metas", "sizes", "dtypes", "num_leaves", "cap_bytes")
+    # __weakref__ lets the Manager key per-bucket error-feedback residuals
+    # by plan identity (WeakKeyDictionary): residuals die with the plan when
+    # the plan cache evicts, instead of leaking per-tree forever
+    __slots__ = (
+        "groups", "metas", "sizes", "dtypes", "num_leaves", "cap_bytes",
+        "__weakref__",
+    )
 
     def __init__(
         self,

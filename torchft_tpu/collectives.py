@@ -36,6 +36,8 @@ from typing import Any, List, Sequence
 import numpy as np
 
 from torchft_tpu.ops.quantization import (
+    compress_bucket,
+    decompress_bucket,
     dequantize_fp8_rowwise,
     fused_dequantize_fp8,
     fused_quantize_fp8,
@@ -44,7 +46,12 @@ from torchft_tpu.ops.quantization import (
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import Future, FutureWork, Work
 
-__all__ = ["allreduce_quantized", "is_device_tree", "reduce_scatter_quantized"]
+__all__ = [
+    "allreduce_compressed",
+    "allreduce_quantized",
+    "is_device_tree",
+    "reduce_scatter_quantized",
+]
 
 _ROW = 512
 
@@ -639,6 +646,38 @@ def allreduce_quantized(
             out = flat if op == ReduceOp.SUM else flat.copy()
             return _unflatten(out, shapes, dtypes)
         return _host_allreduce_pipeline(flat, shapes, dtypes, op, pg, row)
+
+    return _run_async(run)
+
+
+def allreduce_compressed(
+    arrays: Sequence[Any],
+    op: ReduceOp,
+    pg: ProcessGroup,
+    mode: str = "fp8",
+    row: int = _ROW,
+) -> Work:
+    """Compressed allreduce through the PG's self-healing ring.
+
+    Unlike :func:`allreduce_quantized` (alltoall + allgather, one codec
+    boundary per destination chunk), this ships ONE CompressedWire per
+    call straight into ``pg.allreduce`` — on ``ProcessGroupHost`` that is
+    the compressed ring whose reduce step dequantizes → accumulates →
+    requantizes per hop and which re-forms around a dead link
+    mid-collective (``inject_link_fault`` / ``set_reroute_observer``).
+    ``mode`` is ``"fp8"`` or ``"int8"``. The Manager's streaming pipeline
+    uses the same wire per bucket; this is the direct, non-managed entry
+    for tests and custom callers. Host (numpy) inputs only."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"allreduce_compressed supports SUM/AVG, got {op}")
+    flat, shapes, dtypes = _flatten(arrays)
+    wire = compress_bucket(flat, mode, row=row)
+
+    def run() -> List[np.ndarray]:
+        if pg.size() <= 1:
+            return _unflatten(flat.copy(), shapes, dtypes)
+        out = pg.allreduce([wire], op).get_future().wait()
+        return _unflatten(decompress_bucket(out[0]), shapes, dtypes)
 
     return _run_async(run)
 

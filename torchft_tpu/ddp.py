@@ -27,13 +27,15 @@ def ft_allreduce_gradients(
     behavior, ddp.py:66-79): on communicator failure the step's gradients
     resolve to zeros and ``manager.should_commit()`` will discard the step.
     Routes through the streaming bucket pipeline (bit-identical to the
-    serial path) so buckets unpack while later ones are still on the wire;
-    the quantized path keeps the monolithic collective (fp8 wire packing
-    owns its own buffer layout).
+    serial path when uncompressed) so buckets unpack while later ones are
+    still on the wire. ``should_quantize=True`` streams too where the
+    Manager supports it (host PG, streaming on) — buckets ride the wire
+    fp8/int8-compressed with error feedback — and otherwise falls back to
+    the monolithic quantized collective inside the Manager.
     """
-    if should_quantize:
-        return manager.allreduce(grads, should_quantize=True).get_future().wait()
-    return manager.allreduce_streamed(grads).wait()
+    return manager.allreduce_streamed(
+        grads, should_quantize=should_quantize
+    ).wait()
 
 
 class DistributedDataParallel:
@@ -54,13 +56,13 @@ class DistributedDataParallel:
 
     def allreduce_gradients_streamed(self, grads: Any) -> GradStream:
         """Async with per-bucket completion: a GradStream whose ``ready(i)``
-        flips as each bucket lands (quantized trees degenerate to one
-        bucket since the fp8 pipeline packs its own wire buffer)."""
-        if self._should_quantize:
-            work = self._manager.allreduce(grads, should_quantize=True)
-            fut = work.get_future()
-            return GradStream([fut], fut)
-        return self._manager.allreduce_streamed(grads)
+        flips as each bucket lands. Quantized trees stream compressed
+        buckets where the Manager supports it (host PG, streaming on) and
+        degenerate to one bucket otherwise (the monolithic fp8 pipeline
+        packs its own wire buffer)."""
+        return self._manager.allreduce_streamed(
+            grads, should_quantize=self._should_quantize
+        )
 
     def average_gradients(self, grads: Any) -> Any:
         """Blocking: returns the averaged gradient pytree."""
@@ -72,9 +74,10 @@ class PureDistributedDataParallel(DistributedDataParallel):
     leaves pack into flat same-dtype buckets (shared
     ``torchft_tpu/bucketing.py``) and one allreduce is issued per bucket, so
     later buckets overlap earlier ones while a pytree of hundreds of leaves
-    still costs only ``ceil(total_bytes / cap)`` collectives. The quantized
-    path stays per-leaf: collectives.py packs its own wire buffer, and
-    pre-bucketing would shift the fp8 rowwise-scale boundaries."""
+    still costs only ``ceil(total_bytes / cap)`` collectives. Quantized
+    trees stream compressed buckets with error feedback when the Manager
+    supports it (host PG, streaming on); otherwise the Manager falls back
+    to its monolithic quantized collective."""
 
     def __init__(
         self,
@@ -95,11 +98,7 @@ class PureDistributedDataParallel(DistributedDataParallel):
         import jax
 
         leaves, treedef = jax.tree_util.tree_flatten(grads)
-        if (
-            self._should_quantize
-            or len(leaves) <= 1
-            or self._bucket_cap_bytes <= 0
-        ):
+        if len(leaves) <= 1 or self._bucket_cap_bytes <= 0:
             works = [
                 self._manager.allreduce(
                     leaf, should_quantize=self._should_quantize
@@ -113,7 +112,12 @@ class PureDistributedDataParallel(DistributedDataParallel):
         # Manager packs/unpacks with the shared bucketing plan and streams
         # per-bucket collectives, so later buckets ride the wire while
         # earlier ones unpack — strictly more overlap than the old
-        # pack-here-then-wait-per-flat shape, same numerics
+        # pack-here-then-wait-per-flat shape, same numerics. Quantized
+        # trees take the same call: the Manager streams them compressed
+        # (host PG, streaming on) or falls back to its monolithic
+        # quantized collective.
         return self._manager.allreduce_streamed(
-            grads, bucket_cap_bytes=self._bucket_cap_bytes
+            grads,
+            bucket_cap_bytes=self._bucket_cap_bytes,
+            should_quantize=self._should_quantize,
         ).wait()

@@ -193,6 +193,35 @@ def check_health_env() -> Result:
     return True, detail
 
 
+def check_compress_env() -> Result:
+    """``TORCHFT_COMPRESS`` sanity: the value resolves to a known codec
+    (funnelled through the same ``resolve_compress_mode`` the Manager
+    uses, so the doctor and the trainer reject identically), and if
+    compression is ON while bucket streaming is forced OFF the operator
+    is warned — compressed buckets ride the streaming pipeline, so the
+    knob silently does nothing for unquantized trees without it."""
+    try:
+        from torchft_tpu.ops.quantization import resolve_compress_mode
+
+        mode = resolve_compress_mode()
+    except ValueError as e:
+        return False, (
+            f"TORCHFT_COMPRESS invalid: {e}; unset it or pick one of "
+            "off/fp8/int8"
+        )
+    if mode == "off":
+        return True, "compression off (default wire, bit-identical path)"
+    stream_raw = os.environ.get("TORCHFT_STREAM_BUCKETS", "").strip().lower()
+    if stream_raw in ("0", "false", "no", "off"):
+        return None, (
+            f"TORCHFT_COMPRESS={mode} but TORCHFT_STREAM_BUCKETS="
+            f"{stream_raw!r} disables the streaming pipeline compression "
+            "rides — buckets will ship uncompressed; re-enable streaming "
+            "or unset TORCHFT_COMPRESS"
+        )
+    return True, f"compression {mode} (rowwise codec, error feedback on)"
+
+
 def check_health_endpoint() -> Result:
     """Loopback /health probe: a lighthouse with the healthwatch ledger
     enabled serves the JSON an operator's dashboard would scrape, and the
@@ -297,6 +326,7 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("lighthouse", check_lighthouse_roundtrip),
     ("retry-env", check_retry_env),
     ("health-env", check_health_env),
+    ("compress-env", check_compress_env),
     ("health-http", check_health_endpoint),
     ("heal", check_heal_roundtrip),
 ]

@@ -318,10 +318,12 @@ class _Fragment:
                 for k, i in enumerate(self.leaf_indices)
             ]
         assert self._work is None, "fragment already has an allreduce in flight"
-        # Quantized allreduce already concatenates everything into one flat
-        # wire buffer (collectives.py), so pre-bucketing there would add a
-        # redundant copy AND shift fp8 rowwise-scale boundaries (changing
-        # numerics). Bucketize only the unquantized path.
+        # Pre-bucket only the unquantized path. Quantized pseudogradients
+        # go to the Manager whole: it streams them as compressed buckets
+        # with error feedback where supported (host PG, streaming on), and
+        # its MONOLITHIC fallback (collectives.py) concatenates into one
+        # flat wire buffer — pre-bucketing here would add a redundant copy
+        # and pin codec boundaries the Manager already owns.
         if (
             self._use_bucketization
             and not self._should_quantize

@@ -29,6 +29,7 @@ import threading
 import time
 import traceback
 import uuid
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
 from enum import Enum
@@ -55,6 +56,12 @@ from torchft_tpu.observability import (
     log_quorum_event,
     trace_span,
     traced,
+)
+from torchft_tpu.ops.quantization import (
+    compress_bucket,
+    decompress_bucket,
+    is_compressed_wire,
+    resolve_compress_mode,
 )
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import (
@@ -85,6 +92,9 @@ BUCKET_CAP_MB_ENV = "TORCHFT_BUCKET_CAP_MB"
 # per-bucket streaming pipeline for the bucketed allreduce: "0"/"false"
 # forces the serial monolithic path (pack all → one collective → unpack all)
 STREAM_BUCKETS_ENV = "TORCHFT_STREAM_BUCKETS"
+# wire compression for streamed buckets ("off" | "fp8" | "int8"): resolved
+# in ops/quantization.resolve_compress_mode (env TORCHFT_COMPRESS >
+# constructor > "off") so doctor.py validates the same way the Manager does
 
 
 def _to_seconds(t: "float | timedelta") -> float:
@@ -177,6 +187,7 @@ class Manager:
         hostname: str = "",
         bucket_cap_bytes: Optional[int] = None,
         stream_buckets: Optional[bool] = None,
+        compress: Optional[str] = None,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -327,6 +338,20 @@ class Manager:
             self._stream_buckets = bool(stream_buckets)
         else:
             self._stream_buckets = True
+        # wire compression for streamed buckets: TORCHFT_COMPRESS env >
+        # constructor > "off". Raises on a bad value (same message the
+        # doctor check surfaces) rather than training uncompressed silently.
+        self._compress = resolve_compress_mode(compress)
+        # per-(plan, bucket) error-feedback residuals: what quantization
+        # rounded away this step is added back before quantizing the next
+        # step, so the compression error stays bounded instead of
+        # accumulating (LocalSGD/DiLoCo convergence depends on this).
+        # Keyed by plan identity via weakref so evicted plans drop their
+        # residual buffers with them; buffers come from the BufferPool.
+        self._ef_residuals: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+        self._ef_lock = threading.Lock()
 
         self._step = 0
         self._quorum_id = -1
@@ -373,6 +398,7 @@ class Manager:
             "heal_failovers",
             "rpc_retries",
             "chunk_crc_failures",
+            "collective_reroute",
         ):
             self._timings[_counter] = 0.0
         # rpc_retries: every retried control-plane call on either manager
@@ -380,6 +406,12 @@ class Manager:
         # so "the step got slower" is attributable to a named RPC.
         self._client.set_retry_observer(self._on_rpc_retry)
         self._vote_client.set_retry_observer(self._on_rpc_retry)
+        # collective_reroute: the compressed ring re-formed around a dead
+        # link mid-collective. Same pattern as rpc_retries — counter plus a
+        # flight-recorder breadcrumb naming the link.
+        _set_reroute = getattr(pg, "set_reroute_observer", None)
+        if _set_reroute is not None:
+            _set_reroute(self._on_collective_reroute)
         # healthwatch: the group leader piggybacks per-step telemetry on
         # its heartbeat thread (publish_telemetry) and reads the
         # lighthouse's health summary back off the same round-trip. The
@@ -940,6 +972,7 @@ class Manager:
         values: Any,
         reduce_op: ReduceOp = ReduceOp.AVG,
         bucket_cap_bytes: Optional[int] = None,
+        should_quantize: bool = False,
     ) -> GradStream:
         """Streaming variant: per-bucket completion through a GradStream.
 
@@ -947,14 +980,21 @@ class Manager:
         and ordering contract as :meth:`allreduce`, but the returned handle
         exposes ``ready(i)`` per bucket so a gradient-accumulation loop can
         watch buckets land while later microbatches still compute, and
-        ``wait()`` returns the reduced pytree directly. When the tree cannot
-        stream (single leaf, bucketing or streaming disabled, quantized),
-        the handle degenerates to one bucket covering the whole op.
+        ``wait()`` returns the reduced pytree directly.
+        ``should_quantize=True`` streams the buckets COMPRESSED on a
+        host-plane PG (fp8 unless ``TORCHFT_COMPRESS`` picks int8), with
+        per-bucket error feedback — quantization no longer forces the
+        serial monolithic path. When the tree cannot stream (single leaf,
+        bucketing or streaming disabled, device-native quantized), the
+        handle degenerates to one bucket covering the whole op.
         ``bucket_cap_bytes`` overrides the manager's cap for this call
         (``PureDistributedDataParallel`` routes its own cap through here).
         """
         work, stream = self._allreduce(
-            values, False, reduce_op, bucket_cap_bytes=bucket_cap_bytes
+            values,
+            should_quantize,
+            reduce_op,
+            bucket_cap_bytes=bucket_cap_bytes,
         )
         if stream is None:
             fut = work.get_future()
@@ -983,17 +1023,31 @@ class Manager:
         # Bucketed path: pack a multi-leaf tree into a handful of flat
         # same-dtype buffers (shared bucketing.py; plan cached by tree
         # identity + leaf geometry) so the wire carries ceil(bytes/cap)
-        # collectives instead of one per leaf. The quantized path is NEVER
-        # pre-bucketed: collectives.py already concatenates into one flat
-        # wire buffer, and packing first would shift the fp8 rowwise-scale
-        # boundaries (changing numerics).
+        # collectives instead of one per leaf. The MONOLITHIC quantized
+        # path is never pre-bucketed — collectives.py already concatenates
+        # into one flat wire buffer, and packing first would shift the fp8
+        # rowwise-scale boundaries — but when the streaming pipeline is on
+        # and the PG is host-plane, a quantized tree streams as compressed
+        # buckets with error feedback instead (one codec boundary per
+        # bucket, carried per-bucket residuals; see stage() below).
         cap = (
             self._bucket_cap_bytes
             if bucket_cap_bytes is None
             else int(bucket_cap_bytes)
         )
+        # read before the plan gate: the gate and the compression mode both
+        # depend on which plane the collective runs on (full routing
+        # rationale on the comment further down)
+        device_native = getattr(self._pg, "device_native", False)
+        streamable_quant = (
+            should_quantize and self._stream_buckets and not device_native
+        )
         plan: Optional[bucketing.BucketPlan] = None
-        if not should_quantize and len(leaves) > 1 and cap > 0:
+        if (
+            (not should_quantize or streamable_quant)
+            and len(leaves) > 1
+            and cap > 0
+        ):
             try:
                 plan = bucketing.plan_for(leaves, cap, treedef=treedef)
             except Exception:  # noqa: BLE001 — exotic leaves fall back per-leaf
@@ -1079,7 +1133,7 @@ class Manager:
         # issued from an unordered helper thread — goes through the one
         # ordered staging worker (host exchange matches messages purely by
         # arrival order; cross-replica issue order is the contract).
-        device_native = getattr(self._pg, "device_native", False)
+        # (device_native itself is read above, before the plan gate.)
 
         pg_reduce_op = reduce_op
         if reduce_op == ReduceOp.AVG:
@@ -1173,6 +1227,12 @@ class Manager:
                     # partially-applied reduction.
                     try:
                         t0u = time.perf_counter()
+                        if is_compressed_wire(flat):
+                            # the bucket rode the wire compressed; the codes
+                            # carry the reduced SUM, restored here at the
+                            # plan's bucket dtype so divide/slice/land below
+                            # run the exact uncompressed expressions
+                            flat = decompress_bucket(flat)
                         if reduce_op == ReduceOp.AVG and num_participants > 0:
                             flat = (flat / num_participants).astype(
                                 _np_dtype(flat)
@@ -1255,6 +1315,33 @@ class Manager:
                     pooled_ids = {id(b) for b in pooled}
                     stage_timeout = self._timeout
 
+                    # wire compression: TORCHFT_COMPRESS / compress= knob,
+                    # plus should_quantize callers who land here (streaming
+                    # on, host plane) defaulting to fp8. Non-float buckets
+                    # ride uncompressed — the decision depends only on the
+                    # shared plan + mode, so it is SPMD-consistent across
+                    # replicas. Non-participants compress their zero
+                    # contribution too (the ring needs uniform wire
+                    # geometry) but never touch the EF residuals.
+                    compress_mode = self._compress
+                    if should_quantize and compress_mode == "off":
+                        compress_mode = "fp8"
+                    if compress_mode != "off":
+                        bucket_modes = [
+                            compress_mode
+                            if _is_float_dtype(plan.dtypes[i])
+                            else "off"
+                            for i in range(n_buckets)
+                        ]
+                        ef_store = (
+                            self._bucket_residuals(plan)
+                            if participating
+                            else None
+                        )
+                    else:
+                        bucket_modes = ["off"] * n_buckets
+                        ef_store = None
+
                     def _stage_deadline() -> None:
                         try:
                             final_fut.set_exception(
@@ -1285,8 +1372,20 @@ class Manager:
                                         if id(capture[i]) in pooled_ids
                                         else None
                                     )
+                                payload: Any = host_flat
+                                if bucket_modes[i] != "off":
+                                    # quantize inside the pack stage so
+                                    # pack_s absorbs the codec cost and
+                                    # overlap accounting stays honest
+                                    payload = self._compress_bucket_ef(
+                                        host_flat,
+                                        bucket_modes[i],
+                                        plan.dtypes[i],
+                                        ef_store,
+                                        i,
+                                    )
                                 w = self._pg.allreduce(
-                                    [host_flat], pg_reduce_op
+                                    [payload], pg_reduce_op
                                 )
                                 t1b = time.perf_counter()
                                 marks[i]["pack"] = (t0b, t1b)
@@ -1658,6 +1757,74 @@ class Manager:
             replica=self._replica_id,
             group_rank=self._group_rank,
         )
+
+    def _on_collective_reroute(self, pair, attempt: int) -> None:
+        """Re-route observer installed on PGs that support the compressed
+        ring: a mid-collective link failure degraded to a re-routed slow
+        step instead of a swallowed one, and this is the audit trail."""
+        self._bump_counter("collective_reroute")
+        self._logger.warning(
+            f"collective re-routed around dead link {pair} "
+            f"(attempt {attempt})"
+        )
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            "collective_reroute",
+            link=tuple(pair),
+            attempt=attempt,
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+        )
+
+    def _bucket_residuals(self, plan: "bucketing.BucketPlan") -> List[Any]:
+        """Per-bucket error-feedback residual slots for one plan.
+
+        Keyed by plan identity (plans are cached and reused every step, so
+        the same tree keeps the same slots); weakref-keyed so an evicted
+        plan drops its residual buffers with it. Slots start None and are
+        allocated from the BufferPool on first compression."""
+        with self._ef_lock:
+            store = self._ef_residuals.get(plan)
+            if store is None:
+                store = [None] * len(plan)
+                self._ef_residuals[plan] = store
+            return store
+
+    def _compress_bucket_ef(
+        self,
+        host_flat: np.ndarray,
+        mode: str,
+        out_dtype: Any,
+        store: Optional[List[Any]],
+        i: int,
+    ) -> Any:
+        """Quantize one packed bucket for the wire, with error feedback.
+
+        The residual — everything rowwise quantization rounded away this
+        step — is carried into the NEXT step's bucket before quantizing,
+        so the compression error stays bounded (standard EF-SGD) instead
+        of accumulating across LocalSGD/DiLoCo syncs. ``store`` is None
+        for non-participants (zero contribution, nothing to feed back).
+        Runs on the single staging worker, so residual updates for one
+        plan never race."""
+        resid = store[i] if store is not None else None
+        if resid is not None:
+            # one fused pass: the add IS the private f32 copy
+            work = host_flat + resid
+        else:
+            work = np.asarray(host_flat, dtype=np.float32)
+        wire = compress_bucket(work, mode, dtype=out_dtype)
+        if store is not None:
+            resid = store[i]
+            if resid is None:
+                resid = self._buffer_pool.acquire(work.size, np.float32)
+                store[i] = resid
+            np.subtract(
+                work, decompress_bucket(wire, np.float32), out=resid
+            )
+        return wire
 
     def _record_pipeline_timings(self, marks: List[Dict[str, Any]]) -> None:
         """Fold one streamed allreduce's per-bucket stage marks into
@@ -2178,6 +2345,15 @@ class Manager:
 
 def _np_dtype(x: Any) -> Any:
     return np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype
+
+
+def _is_float_dtype(dtype: Any) -> bool:
+    """True for dtypes the wire codecs can compress (incl. ml_dtypes
+    bfloat16, which numpy does not class as np.floating)."""
+    return bool(
+        np.issubdtype(np.dtype(dtype), np.floating)
+        or "bfloat16" in str(dtype)
+    )
 
 
 def _covered_seconds(

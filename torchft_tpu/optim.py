@@ -49,7 +49,9 @@ class OptimizerWrapper:
     # alias for API parity with the reference
     zero_grad = start_step
 
-    def allreduce_gradients(self, grads: Any) -> GradStream:
+    def allreduce_gradients(
+        self, grads: Any, should_quantize: bool = False
+    ) -> GradStream:
         """Kick off a streamed managed allreduce for one microbatch's grads.
 
         Returns immediately with a :class:`GradStream`; buckets reduce and
@@ -58,8 +60,13 @@ class OptimizerWrapper:
         averages the ``wait()`` results after the last one — allreduce is
         linear, so mean-of-streamed-means equals reducing the accumulated
         mean, and every stream's wire rides under the next microbatch's
-        grad_fn (see examples/train_ddp.py ``--grad-accum``)."""
-        return self.manager.allreduce_streamed(grads)
+        grad_fn (see examples/train_ddp.py ``--grad-accum``).
+        ``should_quantize=True`` streams the buckets compressed (fp8
+        unless ``TORCHFT_COMPRESS`` picks int8) with per-bucket error
+        feedback where the Manager supports it."""
+        return self.manager.allreduce_streamed(
+            grads, should_quantize=should_quantize
+        )
 
     def commit(self) -> bool:
         """The commit vote alone (``manager.should_commit()``).

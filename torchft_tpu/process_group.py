@@ -355,6 +355,27 @@ class _Comm:
         # path's world-size-independent per-rank bytes from these)
         self.bytes_sent = 0
         self.bytes_recv = 0
+        # send-side wire occupancy: seconds spent inside sendall pushing
+        # frames into the link. Receive waits are deliberately NOT counted —
+        # a recv blocked on a peer that is still computing would charge
+        # compute time to the wire. bytes_sent / wire_busy_s is the
+        # transport's delivered bandwidth (benchmarks use it for the
+        # compressed-vs-raw effective-bandwidth comparison)
+        self.wire_busy_s = 0.0
+        # injected link faults: {frozenset({a, b}): fire_at_hop}. Shared by
+        # reference with the owning ProcessGroupHost (configure() points this
+        # at the PG-level dict) so tests can arm a fault before OR after the
+        # generation exists. Checked only by the compressed ring's hop loop.
+        self.link_faults: Dict[frozenset, int] = {}
+        # per-comm compressed-collective sequence number: ops dispatch in the
+        # same order on every rank (SPMD contract), so tagging hop frames
+        # with (seq, attempt) lets a re-routed ring tell a stale frame from
+        # a live one without a coordination round
+        self.cring_seq = 0
+        # links this comm has already seen die: later collectives start from
+        # a topology that avoids them instead of re-discovering the failure
+        # (a dead link stays avoided for the life of the generation)
+        self.cring_dead: set = set()
 
         # store_addr is "host:port/prefix"; the prefix (set per-quorum and
         # per-group-rank by the Manager, reference manager.py:703-705) plus the
@@ -408,9 +429,11 @@ class _Comm:
     def send_to(self, peer: int, obj: Any) -> None:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         with self._send_locks[peer]:
+            t0 = time.perf_counter()
             _send_msg(self.peers[peer], payload)
             # counters guarded by the send lock: multiple writer threads
             # (dispatch, ring, p2p) would race the read-modify-write
+            self.wire_busy_s += time.perf_counter() - t0
             self.bytes_sent += len(payload) + _HDR.size
 
     def recv_from(self, peer: int) -> Any:
@@ -428,8 +451,10 @@ class _Comm:
         mv = memoryview(buf).cast("B")
         sock = self.peers[peer]
         with self._send_locks[peer]:
+            t0 = time.perf_counter()
             sock.sendall(_HDR.pack(len(mv)))
             sock.sendall(mv)
+            self.wire_busy_s += time.perf_counter() - t0
             self.bytes_sent += len(mv) + _HDR.size
 
     def recv_raw_into(self, peer: int, out: Any) -> None:
@@ -449,6 +474,37 @@ class _Comm:
                 raise ConnectionError("peer closed connection")
             got += n
         self.bytes_recv += length + _HDR.size
+
+    def check_link_fault(self, a: int, b: int, hop: int) -> None:
+        """Raise ConnectionError if an injected fault covers link (a, b) at
+        this hop. A fired fault stays armed — a dead link stays dead for the
+        generation, which is exactly what forces the ring to re-form around
+        it rather than retry through it."""
+        at_hop = self.link_faults.get(frozenset((a, b)))
+        if at_hop is not None and hop >= at_hop:
+            raise ConnectionError(
+                f"injected link failure {a}<->{b} at hop {hop}"
+            )
+
+    def recv_raw_discard(self, peer: int) -> int:
+        """Read one raw frame from ``peer`` and throw the bytes away.
+
+        Used by the compressed ring's re-route path to drain segment frames
+        that belong to an aborted attempt (their pickled header was read,
+        the raw payload behind it must not be left to corrupt the next
+        attempt's frame stream). Returns the discarded byte count."""
+        sock = self.peers[peer]
+        (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+        got = 0
+        scratch = bytearray(min(length, 1 << 20) or 1)
+        mv = memoryview(scratch)
+        while got < length:
+            n = sock.recv_into(mv, min(length - got, len(scratch)))
+            if n == 0:
+                raise ConnectionError("peer closed connection")
+            got += n
+        self.bytes_recv += length + _HDR.size
+        return length
 
     def _coll_writer_loop(self, q: "queue.Queue") -> None:
         while True:
@@ -657,6 +713,552 @@ def _ring_allreduce(comm: "_Comm", leaves: List[np.ndarray], op: ReduceOp) -> Li
     return out  # type: ignore[return-value]
 
 
+class _LinkFailure(Exception):
+    """One ring hop's link is dead; carries the (lo, hi) rank pair."""
+
+    def __init__(self, a: int, b: int) -> None:
+        self.pair = (min(a, b), max(a, b))
+        super().__init__(
+            f"ring link {self.pair[0]}<->{self.pair[1]} failed"
+        )
+
+
+def _ring_order(world: int, dead: "set") -> Optional[List[int]]:
+    """Deterministic rank ordering whose ring adjacencies (wraparound
+    included) avoid every dead link. Every rank computes this from the same
+    dead set, so the re-formed ring needs no extra coordination round.
+    Returns None when no such ordering exists (e.g. world=2 with its only
+    link dead)."""
+    if not dead:
+        return list(range(world))
+
+    def _ok(order: List[int]) -> bool:
+        return all(
+            frozenset((order[i], order[(i + 1) % world])) not in dead
+            for i in range(world)
+        )
+
+    base = list(range(world))
+    if _ok(base):
+        return base
+    if world <= 8:
+        import itertools
+
+        # rotations of a valid cycle are the same ring, so pinning rank 0
+        # first loses nothing and caps the search at (world-1)!
+        for perm in itertools.permutations(range(1, world)):
+            cand = [0, *perm]
+            if _ok(cand):
+                return cand
+        return None
+    # large worlds: greedy chain extension — dead links are few in practice,
+    # and a miss here degrades to the pre-existing swallowed-step behavior
+    order = [0]
+    rest = list(range(1, world))
+    while rest:
+        nxt = next(
+            (r for r in rest if frozenset((order[-1], r)) not in dead), None
+        )
+        if nxt is None:
+            return None
+        order.append(nxt)
+        rest.remove(nxt)
+    return order if _ok(order) else None
+
+
+def _chain_order(world: int, dead: "set") -> Optional[List[int]]:
+    """Hamiltonian path over healthy links — the fallback for dead-link
+    sets that break every cycle but not every path. Any single dead link at
+    world<=3 is in this class (a 3-cycle needs all three edges), so this is
+    what makes small-world failover possible at all. Deterministic for the
+    same reason as _ring_order."""
+    def _ok(order) -> bool:
+        return all(
+            frozenset((order[i], order[i + 1])) not in dead
+            for i in range(world - 1)
+        )
+
+    base = list(range(world))
+    if _ok(base):
+        return base
+    if world <= 8:
+        import itertools
+
+        for perm in itertools.permutations(range(world)):
+            if perm[0] > perm[-1]:
+                continue  # a path equals its reverse; keep one canonical form
+            if _ok(perm):
+                return list(perm)
+        return None
+    order = [0]
+    rest = list(range(1, world))
+    while rest:
+        nxt = next(
+            (r for r in rest if frozenset((order[-1], r)) not in dead), None
+        )
+        if nxt is None:
+            return None
+        order.append(nxt)
+        rest.remove(nxt)
+    return order
+
+
+def _flood_reroute(
+    comm: "_Comm", left: int, right: int, seq: int, attempt: int, pair
+) -> None:
+    """Best-effort broadcast of a dead link to both ring neighbours.
+
+    Each rank that learns of the failure forwards before restarting, so the
+    signal chains rightward around the ring (every rank's blocking recv is
+    from its left) and unblocks everyone. Sends are small pickled frames on
+    otherwise-healthy sockets; failures (e.g. the dead link itself) are
+    swallowed — the flood only needs one surviving direction."""
+    msg = ("creroute", seq, attempt, (min(pair), max(pair)))
+    for nb in {left, right}:
+        if nb == comm.rank:
+            continue
+        try:
+            comm.send_to(nb, msg)
+        except Exception:  # noqa: BLE001 - best-effort by design
+            pass
+
+
+def _drain_stale_frames(
+    comm: "_Comm", skip_peer: int, seq: int, attempt: int,
+    quiet_s: float = 0.05,
+) -> None:
+    """Best-effort sweep of every peer socket (except the new left, whose
+    stale frames the hop recv loop handles in-line) at the start of a
+    re-routed attempt. The aborted attempt may have left one hop's frames
+    queued on a socket the new ring never reads — and a peer's sendall can
+    be blocked mid-frame on it, so draining here is also what unblocks that
+    peer's collective writer. A current-attempt re-route signal found while
+    draining propagates as _LinkFailure."""
+    for peer in sorted(comm.peers):
+        if peer == skip_peer or peer == comm.rank:
+            continue
+        sock = comm.peers[peer]
+        try:
+            old = sock.gettimeout()
+        except OSError:
+            continue
+        try:
+            while True:
+                sock.settimeout(quiet_s)
+                try:
+                    hdr = comm.recv_from(peer)
+                except OSError:
+                    break  # quiet (or dead) socket — nothing to drain
+                if not (isinstance(hdr, tuple) and len(hdr) == 4):
+                    raise RuntimeError(
+                        f"compressed ring desync draining rank {peer}: "
+                        f"{hdr!r}"
+                    )
+                tag, h_seq, h_attempt, rest = hdr
+                stale = h_seq < seq or (
+                    h_seq == seq and h_attempt < attempt
+                )
+                if tag == "cseg" and stale:
+                    # body frames follow; read them under the op timeout
+                    sock.settimeout(old)
+                    comm.recv_raw_discard(peer)
+                    comm.recv_raw_discard(peer)
+                    continue
+                if tag == "creroute":
+                    if stale:
+                        continue
+                    raise _LinkFailure(*rest)
+                raise RuntimeError(
+                    f"compressed ring desync draining rank {peer}: "
+                    f"tag={tag!r} seq={h_seq} attempt={h_attempt}"
+                )
+        finally:
+            try:
+                sock.settimeout(old)
+            except OSError:
+                pass
+
+
+def _recv_compressed_hop(
+    comm: "_Comm", left: int, seq: int, attempt: int, hop: int,
+    out_q: np.ndarray, out_s: np.ndarray,
+) -> None:
+    """Receive one compressed-ring hop (header + payload + scales frames),
+    draining stale frames from aborted attempts / earlier collectives and
+    converting re-route signals into _LinkFailure."""
+    while True:
+        hdr = comm.recv_from(left)
+        if not (isinstance(hdr, tuple) and len(hdr) == 4):
+            raise RuntimeError(
+                f"unexpected frame on compressed ring: {hdr!r}"
+            )
+        tag, h_seq, h_attempt, rest = hdr
+        stale = h_seq < seq or (h_seq == seq and h_attempt < attempt)
+        if tag == "cseg":
+            if stale:
+                # the aborted attempt's segment bytes follow the header;
+                # drain both frames or they corrupt this attempt's stream
+                comm.recv_raw_discard(left)
+                comm.recv_raw_discard(left)
+                continue
+            if h_seq != seq or h_attempt != attempt or rest != hop:
+                raise RuntimeError(
+                    "compressed ring desync: got "
+                    f"seq={h_seq} attempt={h_attempt} hop={rest}, expected "
+                    f"seq={seq} attempt={attempt} hop={hop}"
+                )
+            comm.recv_raw_into(left, out_q)
+            comm.recv_raw_into(left, out_s)
+            return
+        if tag == "creroute":
+            if stale:
+                continue  # duplicate from an already-handled flood
+            raise _LinkFailure(*rest)
+        raise RuntimeError(f"unexpected compressed ring tag {tag!r}")
+
+
+def _compressed_ring_pass(
+    comm: "_Comm",
+    wire,
+    quantize,
+    dequantize,
+    Q: np.ndarray,
+    S: np.ndarray,
+    rows: int,
+    seg_rows: int,
+    op: ReduceOp,
+    order: List[int],
+    seq: int,
+    attempt: int,
+):
+    """One attempt of the compressed ring over ``order``.
+
+    Reduce-scatter hops carry compressed segments; each hop dequantizes the
+    incoming segment, accumulates in f32, and requantizes the accumulated
+    segment for the next hop (hop 0 forwards the original codes — no extra
+    rounding). The allgather phase circulates the reduced compressed
+    segments verbatim. Restart-safe: all state derives from the immutable
+    (Q, S) input codes, so a _LinkFailure anywhere re-runs cleanly."""
+    world = len(order)
+    pos = order.index(comm.rank)
+    right = order[(pos + 1) % world]
+    left = order[(pos - 1) % world]
+    row = int(wire.row)
+    seg_elems = seg_rows * row
+
+    if attempt > 0:
+        _drain_stale_frames(comm, left, seq, attempt)
+
+    # f32 working accumulation, one slab per chunk (chunk j = rows
+    # [j*seg_rows, (j+1)*seg_rows) of the padded code matrix). Slabs are
+    # decoded lazily at their first accumulate — the chunk this rank sends
+    # at hop 0 leaves as the original codes and never needs an f32 copy
+    acc = np.empty((world, seg_elems), np.float32)
+
+    def _own_slab(j: int) -> np.ndarray:
+        return dequantize(
+            Q[j * seg_rows:(j + 1) * seg_rows],
+            S[j * seg_rows:(j + 1) * seg_rows],
+            seg_elems,
+            np.float32,
+        )
+    recv_q = np.empty((seg_rows, row), np.uint8)
+    recv_s = np.empty(seg_rows, np.float32)
+    hop = 0
+
+    def _send_recv(send_q: np.ndarray, send_s: np.ndarray) -> None:
+        nonlocal hop
+        this_hop = hop
+        try:
+            comm.check_link_fault(comm.rank, right, this_hop)
+        except ConnectionError as e:
+            _flood_reroute(comm, left, right, seq, attempt,
+                           (comm.rank, right))
+            raise _LinkFailure(comm.rank, right) from e
+        try:
+            comm.check_link_fault(left, comm.rank, this_hop)
+        except ConnectionError as e:
+            _flood_reroute(comm, left, right, seq, attempt,
+                           (left, comm.rank))
+            raise _LinkFailure(left, comm.rank) from e
+        hdr = ("cseg", seq, attempt, this_hop)
+
+        def _writes() -> None:
+            comm.send_to(right, hdr)
+            comm.send_raw(right, send_q)
+            comm.send_raw(right, send_s)
+
+        done, err = comm.submit_write(_writes)
+        try:
+            _recv_compressed_hop(
+                comm, left, seq, attempt, this_hop, recv_q, recv_s
+            )
+        except _LinkFailure as lf:
+            # forward the flood before restarting so the signal keeps
+            # chaining rightward past us
+            _flood_reroute(comm, left, right, seq, attempt, lf.pair)
+            raise
+        except (ConnectionError, OSError, ValueError) as e:
+            _flood_reroute(comm, left, right, seq, attempt,
+                           (left, comm.rank))
+            raise _LinkFailure(left, comm.rank) from e
+        finally:
+            done.wait()
+        if err:
+            e = err[0]
+            _flood_reroute(comm, left, right, seq, attempt,
+                           (comm.rank, right))
+            raise _LinkFailure(comm.rank, right) from e
+        hop += 1
+
+    # reduce-scatter: after world-1 hops this rank holds the fully reduced
+    # chunk (pos+1) % world in f32
+    for step in range(world - 1):
+        s_idx = (pos - step) % world
+        r_idx = (pos - step - 1) % world
+        if step == 0:
+            sq = Q[s_idx * seg_rows:(s_idx + 1) * seg_rows]
+            ss = S[s_idx * seg_rows:(s_idx + 1) * seg_rows]
+        else:
+            sq, ss, _ = quantize(acc[s_idx], row=row)
+            ss = np.ascontiguousarray(ss, dtype=np.float32)
+        _send_recv(sq, ss)
+        # each r_idx is distinct across the sweep, so first touch decodes
+        # this rank's own contribution and the hop's payload lands on top
+        acc[r_idx] = _own_slab(r_idx)
+        acc[r_idx] += dequantize(recv_q, recv_s, seg_elems, np.float32)
+
+    own = (pos + 1) % world
+    if op == ReduceOp.AVG:
+        acc[own] /= world
+    q_own, s_own, _ = quantize(acc[own], row=row)
+
+    Qr = np.empty((world, seg_rows, row), np.uint8)
+    Sr = np.empty((world, seg_rows), np.float32)
+    Qr[own] = q_own
+    Sr[own] = np.ascontiguousarray(s_own, dtype=np.float32)
+
+    # allgather: circulate the reduced compressed segments verbatim
+    for step in range(world - 1):
+        s_idx = (pos + 1 - step) % world
+        r_idx = (pos - step) % world
+        _send_recv(Qr[s_idx], Sr[s_idx])
+        Qr[r_idx] = recv_q
+        Sr[r_idx] = recv_s
+
+    from torchft_tpu.ops.quantization import CompressedWire
+
+    return CompressedWire(
+        mode=wire.mode,
+        payload=Qr.reshape(world * seg_rows, row)[:rows].copy(),
+        scales=Sr.reshape(-1)[:rows].copy(),
+        n=wire.n,
+        dtype=wire.dtype,
+        row=row,
+    )
+
+
+def _compressed_chain_pass(
+    comm: "_Comm",
+    wire,
+    quantize,
+    dequantize,
+    Q: np.ndarray,
+    S: np.ndarray,
+    rows: int,
+    op: ReduceOp,
+    order: List[int],
+    seq: int,
+    attempt: int,
+):
+    """Degraded open-chain attempt used when the dead-link set leaves no
+    ring but still admits a Hamiltonian path. The reduce sweeps head→tail
+    (each hop dequantizes, accumulates in f32, requantizes the full
+    buffer), the tail finishes the op (AVG divide) and the reduced codes
+    ride back tail→head verbatim. Each rank moves 2 full-buffer hops of
+    wire instead of the ring's 2×(1/world) segments — correctness over
+    bandwidth, which is the right trade for a re-routed slow step.
+
+    Hop labels are global chain positions (reduce hop i = order[i]→
+    order[i+1], broadcast hop (w-1)+(w-1-i) = order[i+1]→order[i]) so both
+    endpoints of a hop agree without per-rank counters."""
+    world = len(order)
+    pos = order.index(comm.rank)
+    # comm.rank as a sentinel "no neighbour": _flood_reroute skips self
+    left = order[pos - 1] if pos > 0 else comm.rank
+    right = order[pos + 1] if pos < world - 1 else comm.rank
+    row = int(wire.row)
+    pad_rows = Q.shape[0]
+
+    if attempt > 0:
+        _drain_stale_frames(comm, left if pos > 0 else right, seq, attempt)
+
+    recv_q = np.empty((pad_rows, row), np.uint8)
+    recv_s = np.empty(pad_rows, np.float32)
+
+    def _checked(a: int, b: int, hop: int) -> None:
+        try:
+            comm.check_link_fault(a, b, hop)
+        except ConnectionError as e:
+            _flood_reroute(comm, left, right, seq, attempt, (a, b))
+            raise _LinkFailure(a, b) from e
+
+    def _send(peer: int, hop: int, sq: np.ndarray, ss: np.ndarray) -> None:
+        _checked(comm.rank, peer, hop)
+        hdr = ("cseg", seq, attempt, hop)
+
+        def _writes() -> None:
+            comm.send_to(peer, hdr)
+            comm.send_raw(peer, sq)
+            comm.send_raw(peer, ss)
+
+        done, err = comm.submit_write(_writes)
+        done.wait()
+        if err:
+            _flood_reroute(comm, left, right, seq, attempt,
+                           (comm.rank, peer))
+            raise _LinkFailure(comm.rank, peer) from err[0]
+
+    def _recv(peer: int, hop: int) -> None:
+        _checked(peer, comm.rank, hop)
+        try:
+            _recv_compressed_hop(
+                comm, peer, seq, attempt, hop, recv_q, recv_s
+            )
+        except _LinkFailure as lf:
+            _flood_reroute(comm, left, right, seq, attempt, lf.pair)
+            raise
+        except (ConnectionError, OSError, ValueError) as e:
+            _flood_reroute(comm, left, right, seq, attempt,
+                           (peer, comm.rank))
+            raise _LinkFailure(peer, comm.rank) from e
+
+    # reduce sweep head → tail
+    acc = None
+    if pos > 0:
+        _recv(left, pos - 1)
+        acc = dequantize(Q, S, Q.size, np.float32)
+        acc += dequantize(recv_q, recv_s, Q.size, np.float32)
+    if pos < world - 1:
+        if acc is None:  # chain head forwards its original codes unrounded
+            sq, ss = Q, S
+        else:
+            sq, ss, _ = quantize(acc, row=row)
+            ss = np.ascontiguousarray(ss, dtype=np.float32)
+        _send(right, pos, sq, ss)
+        # broadcast sweep tail → head
+        _recv(right, (world - 1) + (world - 1 - pos))
+        out_q = recv_q.copy()
+        out_s = recv_s.copy()
+    else:
+        if op == ReduceOp.AVG:
+            acc /= world
+        oq, os_, _ = quantize(acc, row=row)
+        out_q = np.asarray(oq)
+        out_s = np.ascontiguousarray(os_, dtype=np.float32)
+    if pos > 0:
+        _send(left, (world - 1) + (world - 1 - (pos - 1)), out_q, out_s)
+
+    from torchft_tpu.ops.quantization import CompressedWire
+
+    return CompressedWire(
+        mode=wire.mode,
+        payload=out_q.reshape(pad_rows, row)[:rows].copy(),
+        scales=out_s.reshape(-1)[:rows].copy(),
+        n=wire.n,
+        dtype=wire.dtype,
+        row=row,
+    )
+
+
+def _ring_allreduce_compressed(
+    comm: "_Comm",
+    wire,
+    op: ReduceOp,
+    timeout: float = 60.0,
+    on_reroute=None,
+):
+    """Compressed ring allreduce with mid-collective link failover.
+
+    The FT layer lives *inside* the collective (R2CCL, PAPERS.md): a hop
+    failure — socket error or injected ``link_faults`` entry — floods a
+    re-route signal around the ring, every rank restarts under the shared
+    ``retry.py`` policy (TORCHFT_RETRY_*), and the ring re-forms over a
+    deterministic ordering that avoids every known-dead link. The step
+    finishes as a re-routed slow step instead of a swallowed one.
+    ``on_reroute(pair, attempt)`` fires once per re-route on the rank(s)
+    that initiated or learned of it, before the restart."""
+    from torchft_tpu.ops.quantization import codec
+    from torchft_tpu.retry import RetryPolicy, retry_call
+
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(
+            f"compressed allreduce supports SUM and AVG, got {op}"
+        )
+    quantize, dequantize = codec(wire.mode)
+    world = comm.world
+    seq = comm.cring_seq
+    comm.cring_seq = seq + 1
+
+    scales = np.asarray(wire.scales, dtype=np.float32).reshape(-1)
+    rows = int(scales.size)
+    row = int(wire.row)
+    seg_rows = max(1, -(-rows // world))
+    pad_rows = seg_rows * world
+    Q = np.zeros((pad_rows, row), np.uint8)
+    Q[:rows] = np.asarray(wire.payload).reshape(rows, row)
+    S = np.ones(pad_rows, np.float32)
+    S[:rows] = scales
+
+    # seed from the comm's known-dead set: once a link has killed one
+    # collective, later collectives on this generation route around it from
+    # attempt 0 instead of re-discovering the failure every step
+    dead: set = set(comm.cring_dead)
+    state = {"attempt": 0}
+
+    def _attempt(_remaining: float):
+        order = _ring_order(world, dead)
+        chain = None
+        if order is None:
+            # no surviving cycle — fall back to an open chain (any single
+            # dead link at world<=3 lands here: a 3-cycle needs all edges)
+            chain = _chain_order(world, dead)
+            if chain is None:
+                raise RuntimeError(
+                    f"compressed ring cannot re-form at world={world}: "
+                    f"dead links "
+                    f"{sorted(tuple(sorted(d)) for d in dead)} leave no "
+                    "valid ring or chain ordering"
+                )
+        try:
+            if order is not None:
+                return _compressed_ring_pass(
+                    comm, wire, quantize, dequantize, Q, S, rows, seg_rows,
+                    op, order, seq, state["attempt"],
+                )
+            return _compressed_chain_pass(
+                comm, wire, quantize, dequantize, Q, S, rows,
+                op, chain, seq, state["attempt"],
+            )
+        except _LinkFailure as lf:
+            dead.add(frozenset(lf.pair))
+            comm.cring_dead.add(frozenset(lf.pair))
+            state["attempt"] += 1
+            if on_reroute is not None:
+                try:
+                    on_reroute(lf.pair, state["attempt"])
+                except Exception:  # noqa: BLE001 - observer must not kill op
+                    pass
+            raise
+
+    return retry_call(
+        _attempt,
+        RetryPolicy.from_env(),
+        timeout=timeout,
+        retryable=(_LinkFailure,),
+    )
+
+
 class ProcessGroupHost(ProcessGroup):
     """CPU collectives over a TCP full mesh between replica groups.
 
@@ -715,6 +1317,47 @@ class ProcessGroupHost(ProcessGroup):
         self._rank = 0
         self._world = 1
         self._lock = threading.Lock()
+        # injected link faults (tests / chaos): shared by reference with
+        # every generation's _Comm so arming works before or after configure
+        self._link_faults: Dict[frozenset, int] = {}
+        self._reroute_observer: Optional[Callable[[tuple, int], None]] = None
+        # wire counters folded in from retired generations so wire_stats()
+        # stays monotonic across reconfigures
+        self._wire_totals = {"bytes_sent": 0, "bytes_recv": 0, "busy_s": 0.0}
+
+    # -- fault injection & failover observability -------------------------
+    def inject_link_fault(self, src: int, dst: int, at_hop: int = 0) -> None:
+        """Sever ring link (src, dst) from hop ``at_hop`` of every
+        compressed collective on this PG — the network-fault analog of
+        FakeProcessGroupWrapper.report_future_error, but *inside* the
+        collective so the ring's re-route path is what recovers. The link
+        stays dead until :meth:`clear_link_faults`."""
+        self._link_faults[frozenset((int(src), int(dst)))] = int(at_hop)
+
+    def clear_link_faults(self) -> None:
+        self._link_faults.clear()
+
+    def set_reroute_observer(self, fn) -> None:
+        """``fn(dead_pair, attempt)`` fires on every mid-collective
+        re-route (Manager wires this into the ``collective_reroute``
+        counter and a flight-recorder breadcrumb)."""
+        self._reroute_observer = fn
+
+    def wire_stats(self) -> Dict[str, float]:
+        """Cumulative transport counters across every generation this PG
+        has run: frame bytes sent/received and ``wire_busy_s`` — seconds
+        the sender spent inside sendall actually pushing those bytes
+        (receive waits excluded; see _Comm.wire_busy_s).
+        ``bytes_sent / wire_busy_s`` is the delivered wire bandwidth the
+        compressed-allreduce bench compares across compress modes."""
+        with self._lock:
+            out = dict(self._wire_totals)
+            gen = self._gen
+        if gen is not None:
+            out["bytes_sent"] += gen.comm.bytes_sent
+            out["bytes_recv"] += gen.comm.bytes_recv
+            out["busy_s"] += gen.comm.wire_busy_s
+        return out
 
     # -- lifecycle --------------------------------------------------------
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
@@ -725,11 +1368,18 @@ class ProcessGroupHost(ProcessGroup):
             quorum_id=quorum_id,
             timeout=self._timeout,
         )
+        # share (not copy) the fault registry: arming after configure must
+        # reach the live generation
+        comm.link_faults = self._link_faults
         gen = ProcessGroupHost._Generation(comm)
         with self._lock:
             old, self._gen = self._gen, gen
             self._rank = replica_rank
             self._world = replica_world_size
+            if old is not None:
+                self._wire_totals["bytes_sent"] += old.comm.bytes_sent
+                self._wire_totals["bytes_recv"] += old.comm.bytes_recv
+                self._wire_totals["busy_s"] += old.comm.wire_busy_s
         if old is not None:
             old.abort()
             old.queue.put(None)
@@ -833,9 +1483,31 @@ class ProcessGroupHost(ProcessGroup):
 
     # -- collectives ------------------------------------------------------
     def allreduce(self, arrays, op=ReduceOp.SUM):
+        from torchft_tpu.ops.quantization import CompressedWire
+
         host = [_to_host(a) for a in arrays]
 
         def _run(comm):
+            # compressed buckets always ride the self-healing ring: it is
+            # the only path whose reduce step can dequantize→accumulate→
+            # requantize per hop, and the only one that can re-route around
+            # a dead link mid-collective
+            if len(host) == 1 and isinstance(host[0], CompressedWire):
+                wire = host[0]
+                if comm.world == 1:
+                    return [
+                        CompressedWire(
+                            wire.mode, wire.payload.copy(),
+                            wire.scales.copy(), wire.n, wire.dtype,
+                            wire.row,
+                        )
+                    ]
+                return [
+                    _ring_allreduce_compressed(
+                        comm, wire, op, timeout=self._timeout,
+                        on_reroute=self._reroute_observer,
+                    )
+                ]
             if comm.world == 1:
                 # independent copies: at world >= 2 results never alias the
                 # inputs (the ring/exchange paths allocate), and the
@@ -1644,6 +2316,24 @@ class FakeProcessGroupWrapper(ProcessGroup):
 
     def set_prepare_hook(self, fn: Optional[Callable[[], None]]) -> None:
         self._on_prepare = fn
+
+    # -- compressed-ring failover passthroughs ----------------------------
+    # (EventInjector.kill_link and the Manager's reroute counter reach the
+    # wrapped host PG through these; non-host PGs silently no-op)
+    def inject_link_fault(self, src: int, dst: int, at_hop: int = 0) -> None:
+        fn = getattr(self._pg, "inject_link_fault", None)
+        if fn is not None:
+            fn(src, dst, at_hop)
+
+    def clear_link_faults(self) -> None:
+        fn = getattr(self._pg, "clear_link_faults", None)
+        if fn is not None:
+            fn()
+
+    def set_reroute_observer(self, fn) -> None:
+        setter = getattr(self._pg, "set_reroute_observer", None)
+        if setter is not None:
+            setter(fn)
 
     def configure(self, store_addr, replica_rank, replica_world_size, quorum_id=0):
         if self._next_configure_error is not None:

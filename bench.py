@@ -535,6 +535,98 @@ def healthwatch(smoke: bool = False) -> None:
     }))
 
 
+def tracing_metrics(steps: int = 30, warmup: int = 5, batch_size: int = 8,
+                    scrapes: int = 10000) -> dict:
+    """Tracing-plane steady-state cost + /metrics under load: the example
+    trainer under a Manager with the span recorder on and the Prometheus
+    endpoint serving, scraper threads hammering /metrics until the scrape
+    budget lands, then the span record paths micro-timed directly.
+    CPU-pinned subprocess, same isolation policy as the other FT rows."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    child = (
+        "from torchft_tpu.utils import force_virtual_cpu_devices\n"
+        "force_virtual_cpu_devices(1)\n"
+        "import sys, json\n"
+        f"sys.path.insert(0, {os.path.join(os.path.dirname(os.path.abspath(__file__)), 'benchmarks')!r})\n"
+        "from tracing_bench import run\n"
+        f"print('TRACING ' + json.dumps(run(steps={steps}, "
+        f"warmup={warmup}, batch_size={batch_size}, scrapes={scrapes})))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=420,
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("TRACING "):
+            return _json.loads(line[len("TRACING "):])
+    raise RuntimeError(
+        f"tracing child failed rc={out.returncode}: "
+        f"{(out.stderr or out.stdout)[-300:]}"
+    )
+
+
+def tracing(smoke: bool = False) -> None:
+    """``python bench.py --tracing [--smoke]``: one JSON line with
+    ``tracing_overhead_pct`` (per-span record cost × observed spans/step
+    as a share of the managed step) and the /metrics-under-load tallies.
+    The gates hold the subsystem's two promises: default-on tracing costs
+    under 1% of a managed step, and the Prometheus endpoint answers every
+    scrape of a 10k-scrape hammering while training is live (smoke mode
+    shrinks the loop and the scrape budget, not the assertions). The full
+    run's output is the committed BENCH_TRACE.json."""
+    if smoke:
+        metrics = tracing_metrics(steps=8, warmup=2, scrapes=300)
+    else:
+        metrics = tracing_metrics()
+    required = [
+        "tracing_overhead_pct",
+        "tracing_span_cost_us",
+        "tracing_spans_per_step",
+        "trace_merged_events",
+        "metrics_scrapes_ok",
+        "metrics_scrapes_failed",
+        "metrics_series",
+    ]
+    missing = [k for k in required if metrics.get(k) is None]
+    if missing:
+        raise RuntimeError(f"tracing: missing keys: {missing}")
+    if not metrics["tracing_overhead_pct"] < 1.0:
+        raise RuntimeError(
+            f"tracing: overhead {metrics['tracing_overhead_pct']}% >= 1% "
+            "of the managed step — span recording grew a real cost"
+        )
+    if not metrics["tracing_spans_per_step"] > 0:
+        raise RuntimeError(
+            "tracing: zero spans per step — the Manager's hot-loop "
+            "instrumentation is no longer reaching the recorder"
+        )
+    if metrics["metrics_scrapes_failed"] != 0:
+        raise RuntimeError(
+            f"tracing: {metrics['metrics_scrapes_failed']} /metrics "
+            "scrapes failed under load: "
+            f"{metrics.get('metrics_scrape_first_error')}"
+        )
+    expected_scrapes = 300 if smoke else 10000
+    if metrics["metrics_scrapes_ok"] < expected_scrapes:
+        raise RuntimeError(
+            f"tracing: only {metrics['metrics_scrapes_ok']} of "
+            f"{expected_scrapes} /metrics scrapes answered"
+        )
+    print(json.dumps({
+        "metric": "tracing steady-state cost (example trainer)",
+        "value": metrics["tracing_overhead_pct"],
+        "unit": "%",
+        "vs_baseline": 1,
+        **metrics,
+    }))
+
+
 def main() -> None:
     # shared fallback policy (ensure_responsive_backend): one probe, one
     # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
@@ -746,6 +838,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         record["healthwatch_error"] = str(e)[:200]
 
+    # tracing-plane cost + /metrics under load (best-effort, same policy)
+    try:
+        record.update(tracing_metrics())
+    except Exception as e:  # noqa: BLE001
+        record["tracing_error"] = str(e)[:200]
+
     print(json.dumps(record))
 
 
@@ -801,6 +899,10 @@ if __name__ == "__main__":
     if "--healthwatch" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         healthwatch(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--tracing" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        tracing(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--smoke" in sys.argv[1:]:
         # no always-emit wrapper here: the smoke gate must fail loudly

@@ -11,6 +11,7 @@
 #include <string>
 
 #include "healthwatch.h"
+#include "history.h"
 #include "kvstore.h"
 #include "lighthouse.h"
 #include "manager_server.h"
@@ -93,6 +94,7 @@ int tft_lighthouse_new_v2(const char* opts_json, void** out, char** err) {
         j.get_or("quorum_tick_ms", Json(int64_t{100})).as_int();
     opts.heartbeat_timeout_ms =
         j.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
+    opts.history_path = j.get_or("history_path", Json("")).as_string();
     HealthOpts health =
         HealthOpts::from_json(j.get_or("health", Json::object()));
     *out = new Lighthouse(bind, opts, health);
@@ -140,6 +142,10 @@ int tft_manager_publish_telemetry(void* h, const char* telemetry_json,
 
 char* tft_manager_health(void* h) {
   return dup_str(static_cast<ManagerServer*>(h)->health_json());
+}
+
+char* tft_manager_clock_skew(void* h) {
+  return dup_str(static_cast<ManagerServer*>(h)->clock_skew_json());
 }
 
 char* tft_manager_address(void* h) {
@@ -325,6 +331,38 @@ int tft_health_replay(const char* script_json, const char* opts_json,
     Json ex = Json::array();
     for (const auto& rid : ledger.exclusions()) ex.push_back(rid);
     out["excluded"] = ex;
+    if (result) *result = dup_str(out.dump());
+    return TFT_OK;
+  })
+}
+
+// ------------------------------------------------------ recorded history
+// Read path for the lighthouse's history JSONL (history.h). Takes the file
+// CONTENT (not a path) so tests and remote tooling can feed bytes from
+// anywhere; returns {"events": [...], "summary": {...}} where summary is
+// the pure history_fold — mirrored by torchft_tpu.tracing.history_fold,
+// parity pinned by test (same convention as tft_health_replay).
+int tft_history_replay(const char* jsonl, char** result, char** err) {
+  TFT_TRY({
+    Json events = Json::array();
+    std::string text(jsonl);
+    size_t pos = 0;
+    while (pos <= text.size()) {
+      size_t nl = text.find('\n', pos);
+      size_t end = nl == std::string::npos ? text.size() : nl;
+      std::string line = text.substr(pos, end - pos);
+      pos = end + 1;
+      // skip blank lines (trailing newline, hand-edited files)
+      if (line.find_first_not_of(" \t\r") == std::string::npos) {
+        if (nl == std::string::npos) break;
+        continue;
+      }
+      events.push_back(Json::parse(line));
+      if (nl == std::string::npos) break;
+    }
+    Json out = Json::object();
+    out["events"] = events;
+    out["summary"] = history_fold(events);
     if (result) *result = dup_str(out.dump());
     return TFT_OK;
   })

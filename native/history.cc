@@ -1,0 +1,93 @@
+#include "history.h"
+
+#include <algorithm>
+#include <set>
+
+#include "quorum.h"  // epoch_millis_now
+
+namespace tft {
+
+HistoryStore::HistoryStore(std::string path) : path_(std::move(path)) {
+  if (path_.empty()) return;
+  out_.open(path_, std::ios::out | std::ios::app);
+  if (!out_.is_open()) path_.clear();  // unwritable -> disabled, not fatal
+}
+
+void HistoryStore::append(Json event) {
+  if (path_.empty()) return;
+  try {
+    std::lock_guard<std::mutex> lk(mu_);
+    seq_ += 1;
+    event["seq"] = seq_;
+    event["ts_ms"] = epoch_millis_now();
+    out_ << event.dump() << "\n";
+    // Flush per event: the store exists for postmortems and live replay;
+    // a buffered tail lost to a crash defeats both. Event rates are
+    // control-plane (per quorum/heal/beat-step), not hot-loop.
+    out_.flush();
+  } catch (const std::exception&) {
+    // never let history IO take down the control plane
+  }
+}
+
+int64_t HistoryStore::events_written() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return seq_;
+}
+
+Json history_fold(const Json& events) {
+  Json kinds = Json::object();
+  std::set<std::string> replicas;
+  int64_t count = 0;
+  int64_t last_quorum_id = -1;
+  int64_t max_step = -1;
+  int64_t first_ts = -1;
+  int64_t last_ts = -1;
+
+  for (const auto& e : events.as_array()) {
+    count += 1;
+    std::string kind = e.get_or("kind", Json("unknown")).as_string();
+    kinds[kind] =
+        kinds.contains(kind) ? kinds.get(kind).as_int() + 1 : int64_t{1};
+    if (e.contains("replica_id"))
+      replicas.insert(e.get("replica_id").as_string());
+    if (e.contains("participants")) {
+      for (const auto& rid : e.get("participants").as_array())
+        replicas.insert(rid.as_string());
+    }
+    if (e.contains("quorum_id"))
+      last_quorum_id = e.get("quorum_id").as_int();
+    if (e.contains("step"))
+      max_step = std::max(max_step, e.get("step").as_int());
+    if (e.contains("to_step"))
+      max_step = std::max(max_step, e.get("to_step").as_int());
+    if (e.contains("ts_ms")) {
+      int64_t ts = e.get("ts_ms").as_int();
+      if (first_ts < 0) first_ts = ts;
+      last_ts = ts;
+    }
+  }
+
+  auto kind_count = [&](const char* k) -> int64_t {
+    return kinds.contains(k) ? kinds.get(k).as_int() : 0;
+  };
+
+  Json summary = Json::object();
+  summary["count"] = count;
+  summary["kinds"] = kinds;
+  Json rids = Json::array();
+  for (const auto& rid : replicas) rids.push_back(rid);
+  summary["replicas"] = rids;
+  summary["quorum_transitions"] = kind_count("quorum");
+  summary["last_quorum_id"] = last_quorum_id;
+  summary["heals"] = kind_count("heal");
+  summary["ejections"] = kind_count("eject");
+  summary["readmissions"] = kind_count("readmit");
+  summary["warns"] = kind_count("straggler_warn");
+  summary["max_step"] = max_step;
+  summary["first_ts_ms"] = first_ts;
+  summary["last_ts_ms"] = last_ts;
+  return summary;
+}
+
+}  // namespace tft

@@ -1,0 +1,50 @@
+// Recorded-history store: append-only JSONL of lighthouse control-plane
+// events (quorum transitions, heals, health policy actions, telemetry
+// snapshots). This is the replay substrate the ROADMAP's adaptive policy
+// engine consumes: a policy candidate can be benched against the recorded
+// fault/step history of a real run instead of a synthetic script.
+//
+// The write path lives in the lighthouse (one writer, already serialized
+// under its mutex); the read path is the pure fold below, exposed through
+// the C API as tft_history_replay and mirrored line-for-line by
+// torchft_tpu/tracing.py:history_fold (native<->Python parity is pinned by
+// test, the same convention as the healthwatch replay hooks).
+#pragma once
+
+#include <cstdint>
+#include <fstream>
+#include <mutex>
+#include <string>
+
+#include "json.h"
+
+namespace tft {
+
+class HistoryStore {
+ public:
+  // Empty path = disabled (every append is a no-op). The file is opened in
+  // append mode so a restarted lighthouse extends the same history.
+  explicit HistoryStore(std::string path);
+
+  bool enabled() const { return !path_.empty(); }
+  const std::string& path() const { return path_; }
+
+  // Append one event line. The event must carry a "kind" field; the store
+  // stamps "seq" (monotonic per store) and "ts_ms" (epoch millis). IO
+  // errors are swallowed: history must never take down the control plane.
+  void append(Json event);
+
+  int64_t events_written() const;
+
+ private:
+  std::string path_;
+  mutable std::mutex mu_;
+  std::ofstream out_;
+  int64_t seq_ = 0;
+};
+
+// Pure fold over a history event array -> deterministic summary. Mirrored
+// exactly by torchft_tpu.tracing.history_fold; change both together.
+Json history_fold(const Json& events);
+
+}  // namespace tft

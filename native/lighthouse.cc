@@ -1,5 +1,6 @@
 #include "lighthouse.h"
 
+#include <algorithm>
 #include <cstdio>
 #include <sstream>
 
@@ -28,13 +29,30 @@ std::string esc(const std::string& s) {
   }
   return out;
 }
+
+// Prometheus label values: escape backslash, double-quote and newline
+// (the exposition format's escaping rules for label values).
+std::string prom_label(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '\\': out += "\\\\"; break;
+      case '"': out += "\\\""; break;
+      case '\n': out += "\\n"; break;
+      default: out.push_back(c);
+    }
+  }
+  return out;
+}
 }  // namespace
 
 Lighthouse::Lighthouse(const std::string& bind, LighthouseOpts opts,
                        HealthOpts health)
     : opts_(opts),
       ledger_(std::move(health), opts.heartbeat_timeout_ms,
-              opts.min_replicas) {
+              opts.min_replicas),
+      history_(opts.history_path) {
   server_ = std::make_unique<RpcServer>(
       bind,
       [this](const std::string& m, const Json& p, TimePoint d) {
@@ -122,6 +140,37 @@ void Lighthouse::quorum_tick_locked() {
   latest_quorum_ = q;
   quorum_gen_ += 1;
   quorum_cv_.notify_all();
+
+  if (history_.enabled()) {
+    int64_t min_step = participants.front().step;
+    int64_t max_step = participants.front().step;
+    Json rids = Json::array();
+    for (const auto& p : participants) {
+      rids.push_back(p.replica_id);
+      min_step = std::min(min_step, p.step);
+      max_step = std::max(max_step, p.step);
+    }
+    Json e = Json::object();
+    e["kind"] = std::string("quorum");
+    e["quorum_id"] = q.quorum_id;
+    e["participants"] = rids;
+    e["min_step"] = min_step;
+    e["max_step"] = max_step;
+    history_.append(e);
+    // A member below the quorum's max step heals into it: record one heal
+    // event per lagging member so a replay can reconstruct who recovered
+    // from whom-aligned step to which step under which quorum.
+    for (const auto& p : participants) {
+      if (p.step >= max_step) continue;
+      Json h = Json::object();
+      h["kind"] = std::string("heal");
+      h["replica_id"] = p.replica_id;
+      h["from_step"] = p.step;
+      h["to_step"] = max_step;
+      h["quorum_id"] = q.quorum_id;
+      history_.append(h);
+    }
+  }
 }
 
 Json Lighthouse::handle(const std::string& method, const Json& params,
@@ -195,16 +244,38 @@ Json Lighthouse::rpc_heartbeat(const Json& params) {
     telemetry = &t;
   }
   apply_health_events_locked(ledger_.on_heartbeat(replica_id, telemetry, now));
+  // History: sample one telemetry snapshot per (replica, step) — beats
+  // re-sending the same payload cost nothing, matching the ledger's dedup.
+  if (history_.enabled() && telemetry != nullptr) {
+    int64_t step = t.get_or("step", Json(int64_t{-1})).as_int();
+    auto it = history_telemetry_step_.find(replica_id);
+    if (it == history_telemetry_step_.end() || it->second != step) {
+      history_telemetry_step_[replica_id] = step;
+      Json e = Json::object();
+      e["kind"] = std::string("telemetry");
+      e["replica_id"] = replica_id;
+      e["step"] = step;
+      e["telemetry"] = t;
+      history_.append(e);
+    }
+  }
   // The response carries this replica's health summary back to its Manager
   // (surfaced in Manager.timings() and the torchft_health event stream).
+  // server_ms lets the beat loop estimate clock skew vs this lighthouse
+  // from the RPC round-trip (tracing.py stamps it into span exports).
   Json out = Json::object();
   out["health"] = ledger_.replica_json(replica_id);
+  out["server_ms"] = epoch_millis_now();
   return out;
 }
 
 void Lighthouse::apply_health_events_locked(const std::vector<Json>& events) {
-  for (const auto& e : events)
+  for (const auto& e : events) {
     log_info("health: " + e.dump());
+    // Ledger events already carry "kind" (straggler_warn/eject/readmit);
+    // they append to history as-is.
+    history_.append(e);
+  }
   state_.excluded = ledger_.exclusions();
 }
 
@@ -236,6 +307,82 @@ Json Lighthouse::status_json() {
   for (const auto& rid : state_.excluded) ex.push_back(rid);
   j["excluded"] = ex;
   return j;
+}
+
+std::string Lighthouse::metrics_text() {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto now = Clock::now();
+  std::ostringstream os;
+  auto gauge = [&os](const char* name, const char* help, double v) {
+    os << "# HELP " << name << " " << help << "\n# TYPE " << name
+       << " gauge\n" << name << " " << v << "\n";
+  };
+
+  gauge("torchft_lighthouse_quorum_id", "Current quorum id",
+        static_cast<double>(state_.quorum_id));
+  gauge("torchft_lighthouse_fleet_size",
+        "Participants in the most recent quorum",
+        state_.prev_quorum
+            ? static_cast<double>(state_.prev_quorum->participants.size())
+            : 0.0);
+  gauge("torchft_lighthouse_joining",
+        "Replicas currently waiting to join the next quorum",
+        static_cast<double>(state_.participants.size()));
+  gauge("torchft_lighthouse_excluded",
+        "Replicas proactively excluded by the health ledger",
+        static_cast<double>(state_.excluded.size()));
+  os << "# HELP torchft_lighthouse_history_events_total Recorded-history"
+        " events written\n"
+     << "# TYPE torchft_lighthouse_history_events_total counter\n"
+     << "torchft_lighthouse_history_events_total "
+     << history_.events_written() << "\n";
+
+  os << "# HELP torchft_lighthouse_heartbeat_age_ms Milliseconds since the"
+        " replica's last heartbeat\n"
+     << "# TYPE torchft_lighthouse_heartbeat_age_ms gauge\n";
+  for (const auto& [rid, last] : state_.heartbeats) {
+    auto age = std::chrono::duration_cast<Millis>(now - last).count();
+    os << "torchft_lighthouse_heartbeat_age_ms{replica=\"" << prom_label(rid)
+       << "\"} " << age << "\n";
+  }
+
+  // Per-replica health ledger view. state codes match HealthState:
+  // 0=ok 1=warn 2=ejected 3=probation.
+  Json h = ledger_.to_json(now);
+  const auto& reps = h.get("replicas").as_object();
+  os << "# HELP torchft_lighthouse_replica_state Health state code"
+        " (0=ok 1=warn 2=ejected 3=probation)\n"
+     << "# TYPE torchft_lighthouse_replica_state gauge\n";
+  for (const auto& [rid, r] : reps) {
+    std::string state = r.get("state").as_string();
+    int code = state == "warn" ? 1 : state == "ejected" ? 2
+               : state == "probation" ? 3 : 0;
+    os << "torchft_lighthouse_replica_state{replica=\"" << prom_label(rid)
+       << "\"} " << code << "\n";
+  }
+  os << "# HELP torchft_lighthouse_straggler_score Modified-z straggler"
+        " score (quorum-relative compute time)\n"
+     << "# TYPE torchft_lighthouse_straggler_score gauge\n";
+  for (const auto& [rid, r] : reps) {
+    os << "torchft_lighthouse_straggler_score{replica=\"" << prom_label(rid)
+       << "\"} " << r.get("score").as_double() << "\n";
+  }
+  os << "# HELP torchft_lighthouse_replica_ejections_total Times the"
+        " replica was ejected by the health policy\n"
+     << "# TYPE torchft_lighthouse_replica_ejections_total counter\n";
+  for (const auto& [rid, r] : reps) {
+    os << "torchft_lighthouse_replica_ejections_total{replica=\""
+       << prom_label(rid) << "\"} " << r.get("ejections").as_int() << "\n";
+  }
+  os << "# HELP torchft_lighthouse_replica_readmissions_total Times the"
+        " replica was readmitted after probation\n"
+     << "# TYPE torchft_lighthouse_replica_readmissions_total counter\n";
+  for (const auto& [rid, r] : reps) {
+    os << "torchft_lighthouse_replica_readmissions_total{replica=\""
+       << prom_label(rid) << "\"} " << r.get("readmissions").as_int()
+       << "\n";
+  }
+  return os.str();
 }
 
 std::string Lighthouse::status_html() {
@@ -275,6 +422,8 @@ std::tuple<std::string, std::string, std::string> Lighthouse::handle_http(
       return {"200 OK", "text/html", status_html()};
     if (path == "/status") return {"200 OK", "application/json", status_json().dump()};
     if (path == "/health") return {"200 OK", "application/json", health_json().dump()};
+    if (path == "/metrics")
+      return {"200 OK", "text/plain; version=0.0.4", metrics_text()};
     // POST /replica/{id}/kill — forward a Kill RPC to that replica's manager.
     const std::string prefix = "/replica/";
     if (path.rfind(prefix, 0) == 0 && path.size() > prefix.size()) {

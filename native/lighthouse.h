@@ -12,6 +12,7 @@
 #include <thread>
 
 #include "healthwatch.h"
+#include "history.h"
 #include "quorum.h"
 #include "wire.h"
 
@@ -37,6 +38,8 @@ class Lighthouse {
   Json status_json();
   Json health_json();
   std::string status_html();
+  // Prometheus text exposition (served at /metrics beside /health).
+  std::string metrics_text();
   // Must hold mu_. Log + sync ledger exclusions into the quorum state.
   void apply_health_events_locked(const std::vector<Json>& events);
 
@@ -49,6 +52,10 @@ class Lighthouse {
   std::condition_variable quorum_cv_;
   LighthouseState state_;
   HealthLedger ledger_;  // guarded by mu_
+  HistoryStore history_;  // internally locked; appended under mu_
+  // Per-replica last telemetry step recorded to history (dedup: a re-sent
+  // beat payload for the same step writes nothing). Guarded by mu_.
+  std::map<std::string, int64_t> history_telemetry_step_;
   // Broadcast channel: bump generation + store latest quorum.
   uint64_t quorum_gen_ = 0;
   std::optional<QuorumSnapshot> latest_quorum_;

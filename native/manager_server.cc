@@ -59,6 +59,17 @@ std::string ManagerServer::health_json() const {
   return last_health_.empty() ? "{}" : last_health_;
 }
 
+std::string ManagerServer::clock_skew_json() const {
+  std::lock_guard<std::mutex> lk(telemetry_mu_);
+  Json j = Json::object();
+  j["skew_ms"] = best_skew_ms_;
+  j["rtt_ms"] = best_rtt_ms_;
+  j["last_skew_ms"] = last_skew_ms_;
+  j["last_rtt_ms"] = last_rtt_ms_;
+  j["samples"] = skew_samples_;
+  return j.dump();
+}
+
 void ManagerServer::heartbeat_loop() {
   while (running_.load()) {
     try {
@@ -73,10 +84,29 @@ void ManagerServer::heartbeat_loop() {
       // the lighthouse's 5s expiry and get a LIVE replica evicted. 2s keeps
       // several retries inside the expiry window.
       int64_t beat_ms = std::min<int64_t>(opts_.connect_timeout_ms, 2000);
+      int64_t t0 = epoch_millis_now();
       Json resp = heartbeat_client_->call("heartbeat", params, Millis(beat_ms));
+      int64_t t1 = epoch_millis_now();
       if (resp.contains("health")) {
         std::lock_guard<std::mutex> lk(telemetry_mu_);
         last_health_ = resp.get("health").dump();
+      }
+      // Skew vs the lighthouse: server_ms against the round-trip midpoint.
+      // Keep the minimum-RTT sample's estimate — its midpoint assumption
+      // (symmetric path) has the least queueing error (NTP's rule).
+      if (resp.contains("server_ms")) {
+        double server_ms =
+            static_cast<double>(resp.get("server_ms").as_int());
+        double rtt = static_cast<double>(t1 - t0);
+        double skew = server_ms - (static_cast<double>(t0 + t1) / 2.0);
+        std::lock_guard<std::mutex> lk(telemetry_mu_);
+        skew_samples_ += 1;
+        last_rtt_ms_ = rtt;
+        last_skew_ms_ = skew;
+        if (skew_samples_ == 1 || rtt <= best_rtt_ms_) {
+          best_rtt_ms_ = rtt;
+          best_skew_ms_ = skew;
+        }
       }
     } catch (const std::exception& e) {
       log_info(opts_.replica_id,

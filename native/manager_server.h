@@ -47,6 +47,14 @@ class ManagerServer {
   void publish_telemetry(const std::string& telemetry_json);
   std::string health_json() const;  // "{}" until the first beat round-trips
 
+  // Clock skew vs the lighthouse, estimated from heartbeat round-trips:
+  // the response's server_ms compared against the midpoint of this side's
+  // send/receive epoch times. The kept estimate is the one from the
+  // minimum-RTT beat (least queueing noise). JSON: {"skew_ms", "rtt_ms",
+  // "last_skew_ms", "last_rtt_ms", "samples"}; samples=0 until the first
+  // beat round-trips against a server_ms-aware lighthouse.
+  std::string clock_skew_json() const;
+
  private:
   Json handle(const std::string& method, const Json& params, TimePoint deadline);
   Json rpc_quorum(const Json& params, TimePoint deadline);
@@ -87,6 +95,12 @@ class ManagerServer {
   mutable std::mutex telemetry_mu_;
   Json telemetry_;            // latest published payload (null = none)
   std::string last_health_;   // last heartbeat response's "health" field
+  // Skew estimate state (guarded by telemetry_mu_).
+  double best_skew_ms_ = 0.0;
+  double best_rtt_ms_ = 0.0;
+  double last_skew_ms_ = 0.0;
+  double last_rtt_ms_ = 0.0;
+  int64_t skew_samples_ = 0;
 
   std::atomic<bool> running_{true};
   std::unique_ptr<RpcServer> server_;

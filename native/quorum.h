@@ -55,6 +55,8 @@ struct LighthouseOpts {
   int64_t join_timeout_ms = 60000;
   int64_t quorum_tick_ms = 100;
   int64_t heartbeat_timeout_ms = 5000;
+  // Recorded-history JSONL path (history.h); empty = disabled.
+  std::string history_path;
 };
 
 struct MemberDetails {

@@ -9,7 +9,10 @@ streamed step walls plus the per-bucket stage splits and
 overlap_efficiency must survive end to end. `--healthwatch --smoke` is
 the gate for the health telemetry plane: the per-step publish+fold cost
 must stay under 1% of the managed step and /health must answer every
-poll made while the trainer is live."""
+poll made while the trainer is live. `--tracing --smoke` is the gate for
+the fleet tracing plane: span recording must stay under 1% of the
+managed step and the Prometheus /metrics endpoint must answer every
+scrape made while the trainer is live."""
 
 import json
 import os
@@ -73,6 +76,22 @@ def test_bench_healthwatch_smoke_holds_cost_and_serves_health():
     assert rec["health_polls_failed"] == 0
     assert rec["health_replicas_tracked"] >= 1
     assert rec["health_mode"] == "observe"
+
+
+def test_bench_tracing_smoke_holds_cost_and_serves_metrics():
+    rec = _run_bench("--tracing", "--smoke")
+    # the smoke run itself gates these; re-check the load-bearing ones so
+    # a silently-weakened tracing() still fails CI
+    assert rec["tracing_overhead_pct"] < 1.0
+    assert rec["tracing_span_cost_us"] > 0
+    assert rec["tracing_spans_per_step"] > 0
+    # the hot loop's spans reached the ring with the taxonomy's categories
+    assert {"quorum", "commit"} <= set(rec["trace_categories"])
+    assert rec["trace_merged_events"] > 0
+    # /metrics answered the whole smoke scrape budget under load
+    assert rec["metrics_scrapes_ok"] >= 300
+    assert rec["metrics_scrapes_failed"] == 0
+    assert rec["metrics_series"] > 0
 
 
 def test_bench_allreduce_pipeline_smoke_emits_stage_splits():

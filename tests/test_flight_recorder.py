@@ -100,3 +100,61 @@ def test_pg_abort_dumps(tmp_path, monkeypatch):
     finally:
         pg.shutdown()
         store.shutdown()
+
+
+def test_same_tag_dumps_never_collide(tmp_path, monkeypatch):
+    """Regression: two dumps with the IDENTICAL caller tag in one process
+    (e.g. repeated manager_errors at the same (replica, step, reason))
+    must land in distinct files — the per-instance dump sequence number
+    disambiguates, so the first postmortem is never overwritten."""
+    monkeypatch.setenv(FR_BASE_PATH_ENV, str(tmp_path / "fr"))
+    fr = FlightRecorder(capacity=16)
+    fr.record("manager_error", error="first")
+    p1 = fr.dump(reason="manager_error", quorum_id=7,
+                 tag="rep_a_0_s5_manager_error")
+    fr.record("manager_error", error="second")
+    p2 = fr.dump(reason="manager_error", quorum_id=7,
+                 tag="rep_a_0_s5_manager_error")
+    assert p1 is not None and p2 is not None
+    assert p1 != p2
+    assert p1.exists() and p2.exists()
+    # both carry the shared tag plus a unique suffix, in the same quorum dir
+    assert p1.parent == p2.parent == tmp_path / "fr_quorum_7"
+    assert p1.name.startswith("rep_a_0_s5_manager_error_")
+    assert p2.name.startswith("rep_a_0_s5_manager_error_")
+    # the first dump's evidence survived the second dump
+    first_events = [json.loads(l) for l in p1.read_text().splitlines()]
+    assert any(e.get("error") == "first" for e in first_events)
+    assert not any(e.get("error") == "second" for e in first_events)
+
+
+def test_manager_failure_dump_tags_carry_step_and_reason(tmp_path,
+                                                         monkeypatch):
+    """The Manager's failure-path dump sites tag with
+    (replica, group_rank, step, reason) so concurrent replicas and
+    repeated failures sort into self-describing files."""
+    import threading
+
+    monkeypatch.setenv(FR_BASE_PATH_ENV, str(tmp_path / "fr"))
+    fresh = FlightRecorder(capacity=64)
+    monkeypatch.setattr(fr_mod, "recorder", fresh)
+
+    from torchft_tpu.manager import Manager
+
+    m = Manager.__new__(Manager)
+    m._errored = None
+    m._replica_id = "rep_a"
+    m._group_rank = 1
+    m._step = 5
+    m._quorum_id = 7
+    m._metrics_lock = threading.Lock()
+    m._metrics = {"errors": 0}
+    from torchft_tpu.tracing import SpanRecorder, TraceConfig
+
+    m._tracer = SpanRecorder("rep_a", TraceConfig(enabled=True, buffer=64))
+    m.report_error(RuntimeError("boom"))
+    m.report_error(RuntimeError("boom again"))
+    dumps = sorted((tmp_path / "fr_quorum_7").iterdir())
+    assert len(dumps) == 2
+    for p in dumps:
+        assert p.name.startswith("rep_a_1_s5_manager_error_"), p.name

@@ -134,3 +134,133 @@ class TestEventDrain:
         from torchft_tpu.observability import get_event_drain
 
         assert get_event_drain() is get_event_drain()
+
+
+class TestObservabilityHonestyCounters:
+    """Both observability planes are deliberately lossy (they must never
+    stall the step); timings() therefore carries the loss counters and
+    warns ONCE per Manager when either queue has saturated."""
+
+    def _manager_shell(self, tracer_buffer=16):
+        import threading
+
+        from torchft_tpu.manager import Manager, _ManagerLogger
+        from torchft_tpu.tracing import SpanRecorder, TraceConfig
+
+        m = Manager.__new__(Manager)
+        m._replica_id = "drop_test:0"
+        m._group_rank = 0
+        m._step = 0
+        m._metrics_lock = threading.Lock()
+        m._timings = {}
+        m._tracer = SpanRecorder(
+            "drop_test", TraceConfig(enabled=True, buffer=tracer_buffer)
+        )
+        m._dropped_events_warned = False
+        m._logger = _ManagerLogger(m, m._replica_id, 0)
+        return m
+
+    def test_saturated_queues_surface_and_warn_once(self, caplog,
+                                                    monkeypatch):
+        from types import SimpleNamespace
+
+        from torchft_tpu import manager as manager_mod
+
+        m = self._manager_shell(tracer_buffer=16)
+        # overflow the span ring by 4 and pretend the telemetry drain
+        # already shed 3 events under saturation
+        for i in range(20):
+            m._tracer.instant("e", cat="rpc", i=i)
+        monkeypatch.setattr(
+            manager_mod, "get_event_drain",
+            lambda: SimpleNamespace(dropped=3),
+        )
+        with caplog.at_level(logging.WARNING, logger="torchft_tpu.manager"):
+            t1 = m.timings()
+            t2 = m.timings()
+        assert t1["dropped_events"] == 3.0
+        assert t1["trace_dropped"] == 4.0
+        assert t2["dropped_events"] == 3.0
+        warns = [r for r in caplog.records
+                 if "observability queues saturated" in r.getMessage()]
+        assert len(warns) == 1, "saturation warning must fire exactly once"
+        assert "3 telemetry event(s)" in warns[0].getMessage()
+        assert "4 span(s)" in warns[0].getMessage()
+
+    def test_clean_queues_report_zero_and_stay_quiet(self, caplog,
+                                                     monkeypatch):
+        from types import SimpleNamespace
+
+        from torchft_tpu import manager as manager_mod
+
+        m = self._manager_shell()
+        m._tracer.instant("e", cat="rpc")  # recorded, not dropped
+        monkeypatch.setattr(
+            manager_mod, "get_event_drain",
+            lambda: SimpleNamespace(dropped=0),
+        )
+        with caplog.at_level(logging.WARNING, logger="torchft_tpu.manager"):
+            t = m.timings()
+        assert t["dropped_events"] == 0.0
+        assert t["trace_dropped"] == 0.0
+        assert not [r for r in caplog.records
+                    if "observability queues saturated" in r.getMessage()]
+
+
+class TestMetricsRegistry:
+    def test_render_is_valid_prometheus_text(self):
+        from torchft_tpu.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.gauge_set("torchft_test_gauge", 2.5, "A gauge.")
+        reg.counter_set("torchft_test_total", 7.0, "A counter.")
+        for v in (0.005, 0.05, 0.05, 5.0):
+            reg.observe("torchft_test_seconds", v, "A histogram.")
+        text = reg.render()
+        assert "# HELP torchft_test_gauge A gauge." in text
+        assert "# TYPE torchft_test_gauge gauge" in text
+        assert "torchft_test_gauge 2.5" in text
+        assert "# TYPE torchft_test_total counter" in text
+        assert "torchft_test_total 7" in text
+        # histogram: cumulative buckets + _sum/_count
+        assert "# TYPE torchft_test_seconds histogram" in text
+        assert 'torchft_test_seconds_bucket{le="+Inf"} 4' in text
+        assert "torchft_test_seconds_count 4" in text
+        lines = [l for l in text.splitlines() if "_bucket{" in l]
+        counts = [float(l.rsplit(" ", 1)[1]) for l in lines]
+        assert counts == sorted(counts), "buckets must be cumulative"
+
+    def test_server_serves_and_refreshes(self):
+        import urllib.request
+
+        from torchft_tpu.observability import MetricsRegistry, MetricsServer
+
+        reg = MetricsRegistry()
+        calls = []
+
+        def refresh():
+            calls.append(1)
+            reg.gauge_set("torchft_refresh_gauge", float(len(calls)),
+                          "Scrape-time refresh.")
+
+        srv = MetricsServer(reg, port=0, refresh=refresh)
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                body = resp.read().decode()
+            assert "torchft_refresh_gauge 1" in body
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                body = resp.read().decode()
+            assert "torchft_refresh_gauge 2" in body
+            assert len(calls) == 2
+            # anything but /metrics is a 404, not a crash
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/other"
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5.0)
+                raise AssertionError("expected HTTP 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.shutdown()

@@ -1,0 +1,666 @@
+"""Fleet tracing plane: span recorder, skew-corrected merge, /metrics,
+and the recorded-history parity pin.
+
+Covers the tracing module bottom-up — config env parsing, deterministic
+step sampling, the bounded ring's drop accounting, perf-counter
+anchoring — then the cross-replica guarantees that only hold end to end:
+
+- **skew correction** (``merge_traces``): replicas with injected clock
+  offsets (``EventInjector.skew_clock``) produce raw timestamps that
+  mis-order cross-replica events; the merged timeline must restore the
+  true order within the estimated-skew bound.
+- **history parity**: the SAME JSONL folded through the native read path
+  (``coordination.history_replay`` -> native/history.cc) and the Python
+  fold (``tracing.history_fold``) must agree field-for-field, including
+  on a history file a live lighthouse actually wrote.
+- **/metrics**: both exposition endpoints — the lighthouse's native one
+  and the Manager's Python one — must serve text that parses as
+  Prometheus exposition with the documented series present
+  (docs/observability.md is the reference table).
+- **acceptance**: a 3-replica fleet that suffers one mid-collective link
+  kill (reroute) and one injected step corruption (False vote -> one
+  discarded step -> live heal) under large injected clock offsets must
+  merge — through the real ``python -m torchft_tpu.trace merge`` entry
+  point — into one valid Chrome-trace JSON where the heal spans and the
+  victim's discarded commit vote are visible and cross-replica spans of
+  the same step line up on the corrected timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu import trace as trace_cli
+from torchft_tpu.tracing import (
+    SpanRecorder,
+    TraceConfig,
+    clear_clock_offsets,
+    history_fold,
+    merge_traces,
+    parse_history,
+    set_clock_offset_ms,
+    step_sampled,
+)
+
+LR = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _clean_clock_offsets():
+    yield
+    clear_clock_offsets()
+
+
+def _cfg(buffer: int = 64, sample: float = 1.0, enabled: bool = True,
+         dump_dir: str = "") -> TraceConfig:
+    return TraceConfig(
+        enabled=enabled, buffer=buffer, sample=sample, dump_dir=dump_dir
+    )
+
+
+def _parse_prometheus(text: str) -> dict:
+    """name (labels included) -> value; raises on malformed exposition."""
+    assert "# HELP" in text and "# TYPE" in text, text[:200]
+    series = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        series[name] = float(value)
+    return series
+
+
+def _bare_names(series: dict) -> set:
+    return {k.split("{")[0] for k in series}
+
+
+# ------------------------------------------------------------------- config
+class TestTraceConfig:
+    def test_defaults(self, monkeypatch):
+        for env in ("TORCHFT_TRACE", "TORCHFT_TRACE_BUFFER",
+                    "TORCHFT_TRACE_SAMPLE", "TORCHFT_TRACE_DIR"):
+            monkeypatch.delenv(env, raising=False)
+        cfg = TraceConfig.from_env()
+        assert cfg.enabled is True
+        assert cfg.buffer == 4096
+        assert cfg.sample == 1.0
+        assert cfg.dump_dir == ""
+
+    @pytest.mark.parametrize("val,expect", [
+        ("0", False), ("off", False), ("false", False), ("no", False),
+        ("1", True), ("on", True), ("yes", True),
+    ])
+    def test_master_switch(self, monkeypatch, val, expect):
+        monkeypatch.setenv("TORCHFT_TRACE", val)
+        assert TraceConfig.from_env().enabled is expect
+
+    def test_buffer_floor_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_TRACE_BUFFER", "4")
+        assert TraceConfig.from_env().buffer == 16  # floor, not crash
+        monkeypatch.setenv("TORCHFT_TRACE_BUFFER", "lots")
+        assert TraceConfig.from_env().buffer == 4096
+
+    def test_sample_clamped_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_TRACE_SAMPLE", "1.7")
+        assert TraceConfig.from_env().sample == 1.0
+        monkeypatch.setenv("TORCHFT_TRACE_SAMPLE", "-0.3")
+        assert TraceConfig.from_env().sample == 0.0
+        monkeypatch.setenv("TORCHFT_TRACE_SAMPLE", "half")
+        assert TraceConfig.from_env().sample == 1.0
+
+    def test_dump_dir(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("TORCHFT_TRACE_DIR", str(tmp_path))
+        assert TraceConfig.from_env().dump_dir == str(tmp_path)
+
+
+class TestStepSampled:
+    def test_extremes(self):
+        assert all(step_sampled(s, 1.0) for s in range(100))
+        assert not any(step_sampled(s, 0.0) for s in range(100))
+
+    def test_deterministic_and_roughly_proportional(self):
+        # identical on every call (no RNG) — the property that keeps all
+        # replicas keeping/dropping the SAME steps
+        first = [step_sampled(s, 0.5) for s in range(10000)]
+        second = [step_sampled(s, 0.5) for s in range(10000)]
+        assert first == second
+        frac = sum(first) / len(first)
+        assert 0.4 < frac < 0.6, frac
+
+
+# ----------------------------------------------------------------- recorder
+class TestSpanRecorder:
+    def test_span_context_stamps_context_and_args(self):
+        rec = SpanRecorder("ctx", _cfg())
+        rec.set_context(quorum_id=7, step=3)
+        with rec.span("quorum_rpc", cat="quorum", attempt=2):
+            pass
+        (span,) = rec.export()["spans"]
+        assert span["name"] == "quorum_rpc"
+        assert span["cat"] == "quorum"
+        assert span["quorum_id"] == 7
+        assert span["step"] == 3
+        assert span["args"] == {"attempt": 2}
+        assert span["dur_us"] >= 1
+
+    def test_ring_bound_counts_drops_honestly(self):
+        rec = SpanRecorder("ring", _cfg(buffer=16))
+        for i in range(40):
+            rec.instant("e", cat="rpc", i=i)
+        stats = rec.stats()
+        assert stats["spans"] == 16.0
+        assert stats["recorded"] == 40.0
+        assert stats["dropped"] == 24.0
+        # the ring keeps the newest spans (postmortem wants the end)
+        kept = [s["args"]["i"] for s in rec.export()["spans"]]
+        assert kept == list(range(24, 40))
+
+    def test_disabled_is_a_noop(self):
+        rec = SpanRecorder("off", _cfg(enabled=False))
+        with rec.span("x", cat="quorum"):
+            pass
+        rec.instant("y", cat="rpc")
+        rec.record_rel("z", cat="allreduce", t0_pc=0.0, t1_pc=1.0)
+        assert rec.stats() == {"spans": 0.0, "recorded": 0.0, "dropped": 0.0}
+
+    def test_sampling_follows_step_sampled(self):
+        sample = 0.5
+        on = next(s for s in range(100) if step_sampled(s, sample))
+        off = next(s for s in range(100) if not step_sampled(s, sample))
+        rec = SpanRecorder("samp", _cfg(sample=sample))
+        rec.set_context(step=off)
+        rec.instant("dropped_by_sampling", cat="rpc")
+        rec.set_context(step=on)
+        rec.instant("kept", cat="rpc")
+        spans = rec.export()["spans"]
+        assert [s["name"] for s in spans] == ["kept"]
+
+    def test_record_rel_anchors_to_wall_clock(self):
+        rec = SpanRecorder("rel", _cfg())
+        now_pc = time.perf_counter()
+        now_us = time.time_ns() // 1000
+        rec.record_rel("w", cat="allreduce", t0_pc=now_pc - 0.05,
+                       t1_pc=now_pc, bucket=1)
+        (span,) = rec.export()["spans"]
+        assert abs(span["dur_us"] - 50_000) < 20_000
+        # the interval ends "now" on the wall clock, within scheduler noise
+        assert abs((span["ts_us"] + span["dur_us"]) - now_us) < 30_000
+
+    def test_injected_offset_shifts_clock_and_exported_skew(self):
+        set_clock_offset_ms("offrep", 250.0)
+        rec = SpanRecorder("offrep", _cfg())
+        rec.set_skew(5.0, rtt_ms=2.0, samples=3)
+        rec.instant("tick", cat="rpc")
+        wall_us = time.time_ns() // 1000
+        export = rec.export()
+        # a fast clock is fast in BOTH the stamps and the measured skew,
+        # so the merge correction cancels it
+        assert export["skew_ms"] == pytest.approx(255.0)
+        assert export["rtt_ms"] == 2.0
+        assert export["skew_samples"] == 3
+        (span,) = export["spans"]
+        assert abs(span["ts_us"] - (wall_us + 250_000)) < 50_000
+
+    def test_offset_prefix_matching(self):
+        set_clock_offset_ms("fleet", 100.0)
+        assert SpanRecorder("fleet_3", _cfg()).export()["skew_ms"] == 100.0
+        assert SpanRecorder("other", _cfg()).export()["skew_ms"] == 0.0
+
+    def test_dump_round_trip_creates_parents(self, tmp_path):
+        rec = SpanRecorder("dumper", _cfg())
+        rec.instant("tick", cat="rpc")
+        path = rec.dump(tmp_path / "deep" / "nest" / "d.json")
+        assert path is not None and path.exists()
+        loaded = json.loads(path.read_text())
+        assert loaded["replica_id"] == "dumper"
+        assert loaded["clock"] == "epoch_us"
+        assert len(loaded["spans"]) == 1
+
+    def test_dump_default_destinations(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("TORCHFT_FR_BASE_PATH", raising=False)
+        # no dump dir, no flight-recorder base -> disabled, not an error
+        assert SpanRecorder("nowhere", _cfg()).dump() is None
+        # configured dump dir wins
+        rec = SpanRecorder("dirrep", _cfg(dump_dir=str(tmp_path)))
+        path = rec.dump()
+        assert path is not None and path.parent == tmp_path
+        assert path.name.startswith("trace_dirrep_")
+        # falls back next to the flight-recorder base path
+        monkeypatch.setenv("TORCHFT_FR_BASE_PATH", str(tmp_path / "fr"))
+        path = SpanRecorder("frrep", _cfg()).dump()
+        assert path is not None
+        assert path.parent == tmp_path / "fr_traces"
+
+    def test_dump_never_raises(self, tmp_path):
+        rec = SpanRecorder("safe", _cfg())
+        # target is a directory -> open() fails -> None, no exception
+        assert rec.dump(tmp_path) is None
+
+
+# -------------------------------------------------------------------- merge
+class TestMergeTraces:
+    def _dump(self, rid, skew_ms, spans):
+        return {"replica_id": rid, "clock": "epoch_us", "skew_ms": skew_ms,
+                "rtt_ms": 0.0, "skew_samples": 1, "dropped": 0,
+                "spans": spans}
+
+    def test_structure_and_skew_shift(self):
+        span = {"name": "x", "cat": "quorum", "ts_us": 1_000_000,
+                "dur_us": 10, "quorum_id": 1, "step": 2,
+                "args": {"k": "v"}}
+        trace = merge_traces([
+            self._dump("bbb", 100.0, [span]),
+            self._dump("aaa", -50.0, [dict(span, cat="heal")]),
+        ])
+        assert trace["displayTimeUnit"] == "ms"
+        evs = trace["traceEvents"]
+        assert all(e["ph"] in ("X", "M") for e in evs)
+        procs = {e["args"]["name"]: e["pid"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        # pids ordered by replica_id, labelled with the applied skew
+        assert procs == {"aaa (skew -50.000ms)": 0, "bbb (skew +100.000ms)": 1}
+        xs = {e["args"]["replica_id"]: e for e in evs if e["ph"] == "X"}
+        assert xs["bbb"]["ts"] == 1_000_000 - 100_000
+        assert xs["aaa"]["ts"] == 1_000_000 + 50_000
+        assert xs["bbb"]["args"]["step"] == 2
+        assert xs["bbb"]["args"]["quorum_id"] == 1
+        assert xs["bbb"]["args"]["k"] == "v"
+        threads = {(e["pid"], e["args"]["name"]) for e in evs
+                   if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert (procs["bbb (skew +100.000ms)"], "quorum") in threads
+        assert (procs["aaa (skew -50.000ms)"], "heal") in threads
+
+    def test_skewed_clocks_reorder_raw_but_not_merged(self):
+        """Satellite: inject fixed clock offsets via the event injector and
+        assert the merged timeline restores true cross-replica order within
+        the estimated-skew bound (here exact: offset == estimated skew)."""
+        from torchft_tpu._test.event_injector import EventInjector
+
+        injector = EventInjector()
+        injector.skew_clock("skewfast", 1500.0).skew_clock(
+            "skewslow", -1500.0
+        )
+        try:
+            fast = SpanRecorder("skewfast", _cfg())
+            slow = SpanRecorder("skewslow", _cfg())
+            for r in (fast, slow):
+                r.set_context(quorum_id=1, step=1)
+            fast.instant("mark", cat="quorum")  # true time t0
+            time.sleep(0.12)
+            slow.instant("mark", cat="quorum")  # true time t0 + 120ms
+            d_fast, d_slow = fast.export(), slow.export()
+        finally:
+            injector.clear_clock_skew()
+        # raw stamps lie: the later event appears ~3s EARLIER
+        raw_fast = d_fast["spans"][0]["ts_us"]
+        raw_slow = d_slow["spans"][0]["ts_us"]
+        assert raw_slow < raw_fast - 1_000_000
+        # merged timeline restores the truth
+        evs = merge_traces([d_fast, d_slow])["traceEvents"]
+        ts = {e["args"]["replica_id"]: e["ts"] for e in evs
+              if e["ph"] == "X"}
+        gap_us = ts["skewslow"] - ts["skewfast"]
+        assert gap_us > 0, "skew correction lost the true ordering"
+        # within the estimated-skew bound (exact offsets, so the residual
+        # is just the sleep's scheduler jitter)
+        assert abs(gap_us - 120_000) < 100_000, gap_us
+
+
+# ------------------------------------------------------------------ history
+_HISTORY_EVENTS = [
+    {"kind": "quorum", "quorum_id": 1, "step": 0, "ts_ms": 1000,
+     "participants": ["r0", "r1"]},
+    {"kind": "heal", "replica_id": "r1", "to_step": 5, "ts_ms": 2000},
+    {"kind": "straggler_warn", "replica_id": "r2", "ts_ms": 2500},
+    {"kind": "eject", "replica_id": "r2", "ts_ms": 3000},
+    {"kind": "readmit", "replica_id": "r2", "ts_ms": 4000},
+    {"kind": "telemetry", "replica_id": "r0", "step": 7, "ts_ms": 4500},
+    {"kind": "quorum", "quorum_id": 2, "step": 7, "ts_ms": 5000,
+     "participants": ["r0", "r1", "r2"]},
+    {"no_kind_at_all": True},
+]
+
+
+class TestHistory:
+    def test_parse_history_skips_blanks(self):
+        text = "\n" + json.dumps({"kind": "quorum"}) + "\n\n" + \
+            json.dumps({"kind": "heal"}) + "\n   \n"
+        assert [e["kind"] for e in parse_history(text)] == ["quorum", "heal"]
+
+    def test_fold_covers_every_field(self):
+        summary = history_fold(_HISTORY_EVENTS)
+        assert summary["count"] == 8
+        assert summary["kinds"] == {
+            "quorum": 2, "heal": 1, "straggler_warn": 1, "eject": 1,
+            "readmit": 1, "telemetry": 1, "unknown": 1,
+        }
+        assert summary["replicas"] == ["r0", "r1", "r2"]
+        assert summary["quorum_transitions"] == 2
+        assert summary["last_quorum_id"] == 2
+        assert summary["heals"] == 1
+        assert summary["ejections"] == 1
+        assert summary["readmissions"] == 1
+        assert summary["warns"] == 1
+        assert summary["max_step"] == 7
+        assert summary["first_ts_ms"] == 1000
+        assert summary["last_ts_ms"] == 5000
+
+    def test_native_replay_matches_python_fold(self):
+        """Parity pin: tft_history_replay (native/history.cc) and the
+        canonical Python fold must agree field-for-field on the same
+        JSONL — same convention as the healthwatch replay hooks."""
+        from torchft_tpu import coordination
+
+        text = "\n".join(json.dumps(e) for e in _HISTORY_EVENTS) + "\n\n"
+        native = coordination.history_replay(text)
+        assert native["summary"] == history_fold(parse_history(text))
+        assert len(native["events"]) == len(_HISTORY_EVENTS)
+
+
+# ---------------------------------------------------------------------- CLI
+class TestTraceCLI:
+    @pytest.mark.parametrize("argv", [
+        [], ["merge"], ["merge", "out.json"], ["history"],
+        ["history", "a", "b"], ["bogus"],
+    ])
+    def test_usage(self, argv, capsys):
+        assert trace_cli.main(argv) == 2
+        assert "usage:" in capsys.readouterr().err
+
+    def test_merge_writes_chrome_trace(self, tmp_path, capsys):
+        paths = []
+        for rid in ("r0", "r1"):
+            rec = SpanRecorder(rid, _cfg())
+            rec.set_context(quorum_id=1, step=1)
+            rec.instant("tick", cat="quorum")
+            paths.append(str(rec.dump(tmp_path / f"{rid}.json")))
+        out = tmp_path / "fleet.json"
+        assert trace_cli.main(["merge", str(out), *paths]) == 0
+        assert "merged 2 replica dumps" in capsys.readouterr().out
+        trace = json.loads(out.read_text())
+        rids = {e["args"]["replica_id"] for e in trace["traceEvents"]
+                if e["ph"] == "X"}
+        assert rids == {"r0", "r1"}
+
+    def test_history_prints_fold(self, tmp_path, capsys):
+        p = tmp_path / "history.jsonl"
+        p.write_text("\n".join(json.dumps(e) for e in _HISTORY_EVENTS))
+        assert trace_cli.main(["history", str(p)]) == 0
+        printed = json.loads(capsys.readouterr().out)
+        assert printed == history_fold(_HISTORY_EVENTS)
+
+
+# ------------------------------------------------- live endpoints + history
+def test_manager_and_lighthouse_metrics_serve_prometheus(tmp_path):
+    """Acceptance: both /metrics endpoints serve valid Prometheus text
+    (parsed in-test), and the lighthouse's recorded-history JSONL replays
+    through the native read path with Python parity."""
+    from torchft_tpu import coordination
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    hist_path = tmp_path / "history.jsonl"
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        history_path=str(hist_path),
+    )
+    manager = Manager(
+        pg=ProcessGroupHost(timeout=10.0),
+        load_state_dict=lambda sd: None,
+        state_dict=lambda: {"w": np.zeros(4, np.float32)},
+        min_replica_size=1,
+        replica_id="metrics_probe",
+        lighthouse_addr=f"127.0.0.1:{lh.port}",
+        timeout=10.0,
+        heartbeat_interval=0.05,
+        tracing=True,
+        metrics_port=0,
+    )
+    try:
+        for _ in range(3):
+            manager.start_quorum()
+            manager.allreduce(
+                {"w": np.ones(4, np.float32)}
+            ).get_future().wait(30)
+            manager.should_commit()
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{manager.metrics_port}/metrics", timeout=5.0
+        ) as resp:
+            mgr_series = _parse_prometheus(resp.read().decode())
+        names = _bare_names(mgr_series)
+        assert mgr_series["torchft_manager_step"] >= 3
+        assert mgr_series["torchft_manager_commits_total"] >= 1
+        assert mgr_series["torchft_manager_trace_spans_total"] > 0
+        assert "torchft_manager_dropped_events_total" in names
+        assert "torchft_manager_clock_skew_ms" in names
+        # at least one phase histogram filled at _record_timing write time
+        assert any(n.startswith("torchft_manager_")
+                   and n.endswith("_seconds_bucket") for n in names), names
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{lh.port}/metrics", timeout=5.0
+        ) as resp:
+            lh_series = _parse_prometheus(resp.read().decode())
+        lh_names = _bare_names(lh_series)
+        assert lh_series["torchft_lighthouse_fleet_size"] >= 1
+        assert "torchft_lighthouse_quorum_id" in lh_names
+        assert "torchft_lighthouse_heartbeat_age_ms" in lh_names
+        assert lh_series["torchft_lighthouse_history_events_total"] >= 1
+    finally:
+        manager.shutdown(wait=False)
+        lh.shutdown()
+
+    # the history the live lighthouse recorded replays with native parity
+    text = hist_path.read_text()
+    events = parse_history(text)
+    assert any(e.get("kind") == "quorum" for e in events), events
+    native = coordination.history_replay(text)
+    assert native["summary"] == history_fold(events)
+    assert native["summary"]["quorum_transitions"] >= 1
+
+
+# --------------------------------------------------------------- acceptance
+def test_fleet_chaos_merge_produces_skew_corrected_timeline(tmp_path):
+    """3-replica run with one mid-collective link kill (reroute) and one
+    injected step corruption (False vote -> discarded step -> live heal),
+    under +/-1.5s injected clock offsets; the per-replica dumps merged via
+    the real CLI must show the heal spans and the discarded commit vote on
+    a timeline where cross-replica spans of the same step line up."""
+    from torchft_tpu._test.event_injector import EventInjector
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    n_replicas = 3
+    rounds = 8
+    kill_step = 3
+    error_step = 5
+    victim = 2
+    victim_rid = f"tracefleet_{victim}"
+
+    injector = EventInjector().kill_link(0, 1, step=kill_step, at_hop=1)
+    # replicas 0/1 run on clocks 1.5s fast/slow; the victim keeps true time
+    injector.skew_clock("tracefleet_0", 1500.0)
+    injector.skew_clock("tracefleet_1", -1500.0)
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=n_replicas, join_timeout_ms=5000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=5000,
+    )
+    barrier = threading.Barrier(n_replicas)
+    finals: dict = {}
+    reroutes: dict = {}
+    healed_steps: dict = {}
+    dump_paths: dict = {}
+    failure: list = []
+
+    def replica(rid: int) -> None:
+        grad_base = np.random.RandomState(40 + rid).randn(1024).astype(
+            np.float32
+        )
+        params = {"w": np.zeros(1024, np.float32)}
+
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        pg = ProcessGroupHost(timeout=30.0)
+        manager = Manager(
+            pg=pg,
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=n_replicas,
+            use_async_quorum=False,
+            replica_id=f"tracefleet_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=30.0,
+            quorum_timeout=30.0,
+            # multi-leaf tree + small cap -> multi-bucket streaming plan,
+            # the path the link kill reroutes
+            bucket_cap_bytes=1024,
+            compress="fp8",
+            tracing=True,
+        )
+        try:
+            for _ in range(rounds):
+                barrier.wait(timeout=120)
+                manager.start_quorum()
+                if manager.last_quorum_healed():
+                    healed_steps[rid] = manager.current_step()
+                step = manager.current_step()
+                injector.check(rid, step, pg=pg)
+                g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+                grads = {"a": g[:512].copy(), "b": g[512:].copy()}
+                avg = manager.allreduce(grads).get_future().wait(60)
+                if rid == victim and step == error_step:
+                    # corrupt THIS step only: the vote discards it, the
+                    # next quorum live-heals the replica back to the fleet
+                    manager.report_error(
+                        RuntimeError("injected step corruption")
+                    )
+                if manager.should_commit():
+                    flat = np.concatenate(
+                        [np.asarray(avg["a"]), np.asarray(avg["b"])]
+                    ).astype(np.float32)
+                    params["w"] = (params["w"] - LR * flat).astype(
+                        np.float32
+                    )
+            finals[rid] = params["w"].copy()
+            reroutes[rid] = manager.timings().get("collective_reroute", 0.0)
+            dump_paths[rid] = manager.dump_trace(
+                tmp_path / f"dump_{rid}.json"
+            )
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+
+    ex = ThreadPoolExecutor(max_workers=n_replicas)
+    try:
+        futs = [ex.submit(replica, r) for r in range(n_replicas)]
+        for f in futs:
+            f.result(timeout=240)
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+        injector.clear_clock_skew()
+
+    assert not failure, failure
+    assert set(finals) == set(range(n_replicas)), finals.keys()
+
+    # both chaos events actually happened
+    assert sum(reroutes.values()) >= 1, reroutes
+    assert victim in healed_steps, (
+        "the corrupted replica never live-healed", healed_steps
+    )
+    # the heal restored lockstep: every replica ends bitwise-identical
+    for rid in range(1, n_replicas):
+        np.testing.assert_array_equal(
+            finals[0], finals[rid],
+            err_msg=f"replica {rid} diverged across discard+heal",
+        )
+    assert np.isfinite(finals[0]).all()
+
+    # --- merge through the real CLI entry point
+    assert all(dump_paths.get(r) is not None for r in range(n_replicas))
+    out = tmp_path / "fleet.json"
+    rc = trace_cli.main(
+        ["merge", str(out)] + [str(dump_paths[r]) for r in range(n_replicas)]
+    )
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    assert trace["displayTimeUnit"] == "ms"
+    evs = trace["traceEvents"]
+    assert evs and all(e["ph"] in ("X", "M") for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    procs = [e for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(procs) == n_replicas
+
+    # the control-plane taxonomy is present
+    names = {e["name"] for e in xs}
+    assert {"quorum_rpc", "commit_vote"} <= names, names
+
+    # heal spans: the victim's receive leg must be on the timeline
+    heal_spans = [e for e in xs if e["cat"] == "heal"]
+    assert any(
+        e["name"] == "heal_recv"
+        and e["args"]["replica_id"].startswith(victim_rid)
+        for e in heal_spans
+    ), heal_spans
+
+    # the victim's discarded step is visible: its commit vote at the
+    # corrupted step went False while the peers' votes stayed True
+    votes = [e for e in xs if e["name"] == "commit_vote"]
+    assert any(
+        e["args"]["replica_id"].startswith(victim_rid)
+        and e["args"].get("local") is False
+        and e["args"].get("step") == error_step
+        for e in votes
+    ), votes
+    assert any(
+        not e["args"]["replica_id"].startswith(victim_rid)
+        and e["args"].get("local") is True
+        and e["args"].get("step") == error_step
+        for e in votes
+    ), votes
+
+    # skew correction: replicas 0 (+1.5s clock) and 1 (-1.5s clock) enter
+    # every quorum together (barrier + min_replicas), so their quorum_rpc
+    # spans of the same step must line up on the corrected timeline even
+    # though their raw stamps disagree by ~3s
+    raw = {}
+    for rid in (0, 1):
+        d = json.loads(dump_paths[rid].read_text())
+        assert abs(d["skew_ms"] - (1500.0 if rid == 0 else -1500.0)) < 500.0
+        raw[rid] = {
+            s["step"]: s["ts_us"] for s in reversed(d["spans"])
+            if s["name"] == "quorum_rpc" and s["step"] is not None
+        }
+    corrected = {0: {}, 1: {}}
+    for e in xs:
+        if e["name"] != "quorum_rpc" or e["args"]["step"] is None:
+            continue
+        for rid in (0, 1):
+            if e["args"]["replica_id"].startswith(f"tracefleet_{rid}:"):
+                corrected[rid].setdefault(e["args"]["step"], e["ts"])
+    common = sorted(set(corrected[0]) & set(corrected[1]))
+    assert common, (corrected, "no common quorum_rpc steps")
+    for s in common:
+        assert raw[0][s] - raw[1][s] > 1_500_000, (
+            s, raw, "raw clocks should disagree by ~3s"
+        )
+        assert abs(corrected[0][s] - corrected[1][s]) < 1_000_000, (
+            s, corrected, "corrected timeline did not line up"
+        )

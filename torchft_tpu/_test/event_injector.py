@@ -13,7 +13,10 @@ link mid-collective (armed via ``ProcessGroupHost.inject_link_fault``) so
 the compressed allreduce's in-collective re-route path is what recovers. For the healthwatch plane,
 ``slow_replica`` dilates the step time a replica REPORTS (installed as a
 ``Manager.set_telemetry_transform`` hook) so straggler scoring, proactive
-ejection, and probationary readmission run without real slowdowns.
+ejection, and probationary readmission run without real slowdowns. For the
+tracing plane, ``skew_clock`` shifts a replica's wall clock (timestamps
+and exported skew estimate together) so the trace merger's skew
+correction can be asserted against a known offset.
 """
 
 from __future__ import annotations
@@ -214,6 +217,25 @@ class EventInjector:
             return telemetry
 
         return _transform
+
+    # ------------------------------------------------------------- tracing
+    def skew_clock(self, replica_id: str, offset_ms: float) -> "EventInjector":
+        """Pretend ``replica_id``'s wall clock runs ``offset_ms`` ahead of
+        true time for the tracing plane: its SpanRecorder stamps shifted
+        timestamps AND exports a skew estimate shifted by the same amount
+        (exactly what a genuinely skewed host looks like to the heartbeat
+        estimator), so ``merge_traces`` must correct the ordering back.
+        Matched exactly or by prefix (``train_ddp_0`` arms every rank of
+        replica 0). Call :meth:`clear_clock_skew` on teardown."""
+        from torchft_tpu import tracing
+
+        tracing.set_clock_offset_ms(replica_id, offset_ms)
+        return self
+
+    def clear_clock_skew(self) -> None:
+        from torchft_tpu import tracing
+
+        tracing.clear_clock_offsets()
 
     # ------------------------------------------------- control-plane flakes
     def flake_rpc(

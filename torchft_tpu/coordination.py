@@ -103,6 +103,8 @@ def _load() -> ctypes.CDLL:
         ]
         lib.tft_manager_health.argtypes = [ctypes.c_void_p]
         lib.tft_manager_health.restype = ctypes.c_void_p
+        lib.tft_manager_clock_skew.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_clock_skew.restype = ctypes.c_void_p
         lib.tft_manager_port.argtypes = [ctypes.c_void_p]
         lib.tft_manager_shutdown.argtypes = [ctypes.c_void_p]
         lib.tft_manager_free.argtypes = [ctypes.c_void_p]
@@ -136,6 +138,10 @@ def _load() -> ctypes.CDLL:
         ]
         lib.tft_health_replay.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_history_replay.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_char_p),
         ]
         _lib = lib
@@ -303,10 +309,14 @@ class LighthouseServer:
         quorum_tick_ms: int = 100,
         heartbeat_timeout_ms: int = 5000,
         health: "Optional[dict]" = None,
+        history_path: str = "",
     ) -> None:
         """``health`` configures the healthwatch ledger (HealthOpts fields,
         see torchft_tpu/healthwatch.py); None reads ``TORCHFT_HEALTH_*``
-        from the environment (default: observe mode)."""
+        from the environment (default: observe mode). ``history_path``
+        enables the recorded-history store: append-only JSONL of quorum
+        transitions / heals / health events / telemetry snapshots, readable
+        via :func:`history_replay` (empty = disabled)."""
         lib = _load()
         if health is None:
             from torchft_tpu.healthwatch import HealthConfig
@@ -321,6 +331,7 @@ class LighthouseServer:
             "quorum_tick_ms": quorum_tick_ms,
             "heartbeat_timeout_ms": heartbeat_timeout_ms,
             "health": health,
+            "history_path": history_path,
         }
         status = lib.tft_lighthouse_new_v2(
             json.dumps(opts).encode(), ctypes.byref(handle), ctypes.byref(err)
@@ -411,6 +422,20 @@ class ManagerServer:
         until the first beat round-trips."""
         return json.loads(
             _take_str(self._lib, self._lib.tft_manager_health(self._handle))
+            or "{}"
+        )
+
+    def clock_skew(self) -> dict:
+        """Clock-skew estimate vs the lighthouse from heartbeat round-trips
+        (``skew_ms``/``rtt_ms`` from the minimum-RTT beat, plus
+        ``last_skew_ms``/``last_rtt_ms``/``samples``). ``samples`` is 0
+        until the first beat round-trips; the tracing plane stamps
+        ``skew_ms`` into every span export so the trace merger can place N
+        replicas on one corrected timeline."""
+        return json.loads(
+            _take_str(
+                self._lib, self._lib.tft_manager_clock_skew(self._handle)
+            )
             or "{}"
         )
 
@@ -885,4 +910,24 @@ def health_replay(script: list, opts: dict) -> dict:
     err_s = _take_str(lib, err)
     result_s = _take_str(lib, result)
     _raise_for_status(status, err_s, "health_replay failed")
+    return json.loads(result_s)
+
+
+def history_replay(jsonl_text: str) -> dict:
+    """Parse a recorded-history JSONL (content, not a path) through the
+    NATIVE read path; returns ``{"events": [...], "summary": {...}}``.
+
+    Parity hook for tests: torchft_tpu.tracing.history_fold carries the
+    canonical Python fold and tests pin the native summary to it (same
+    convention as :func:`health_replay`).
+    """
+    lib = _load()
+    result = ctypes.c_char_p()
+    err = ctypes.c_char_p()
+    status = lib.tft_history_replay(
+        jsonl_text.encode(), ctypes.byref(result), ctypes.byref(err)
+    )
+    err_s = _take_str(lib, err)
+    result_s = _take_str(lib, result)
+    _raise_for_status(status, err_s, "history_replay failed")
     return json.loads(result_s)

@@ -81,6 +81,7 @@ class LighthouseServer:
         quorum_tick_ms: int = ...,
         heartbeat_timeout_ms: int = ...,
         health: Optional[dict] = ...,
+        history_path: str = ...,
     ) -> None: ...
     def address(self) -> str: ...
     @property
@@ -105,6 +106,7 @@ class ManagerServer:
     def port(self) -> int: ...
     def publish_telemetry(self, telemetry: dict) -> None: ...
     def health(self) -> dict: ...
+    def clock_skew(self) -> dict: ...
     def shutdown(self) -> None: ...
 
 class KvStoreServer:
@@ -163,3 +165,4 @@ def compute_quorum_results(
 ) -> QuorumResult: ...
 def health_scores(windows: Dict[str, list], opts: dict) -> Dict[str, float]: ...
 def health_replay(script: list, opts: dict) -> dict: ...
+def history_replay(jsonl_text: str) -> dict: ...

@@ -11,7 +11,10 @@ WEDGED instead of hanging the doctor — the failure mode bench.py's
 backoff budget ordered below the quorum timeout), the ``TORCHFT_HEALTH_*``
 healthwatch knobs validate (eject above warn, probation window wide enough
 for probe heartbeats to land) with a loopback ``GET /health`` probe of the
-lighthouse ledger endpoint, and a loopback
+lighthouse ledger endpoint, the ``TORCHFT_TRACE_*`` tracing knobs validate
+strictly (with a writability probe of the trace dump dir) and both
+Prometheus ``/metrics`` exporters (lighthouse native + manager-side
+Python) answer a loopback scrape with parseable text, and a loopback
 live-heal round-trip through the default HTTP transport lands in place —
 with one mid-transfer connection drop injected so the ranged-resume path
 (the tier-1 recovery behavior a rejoining replica depends on) is
@@ -319,6 +322,135 @@ def check_heal_roundtrip() -> Result:
         return False, f"heal round-trip failed: {e}"
 
 
+def check_trace_env() -> Result:
+    """``TORCHFT_TRACE_*`` env sanity, validated STRICTLY (the Manager's
+    ``TraceConfig.from_env`` falls back to defaults on garbage so a typo
+    can't kill a trainer — which is exactly why the doctor must flag it:
+    silently-defaulted knobs are the ones operators chase for hours), plus
+    a writability probe of the configured dump directory — an unwritable
+    dump dir only surfaces at the worst moment (a postmortem auto-dump)."""
+    from torchft_tpu.tracing import (
+        TRACE_BUFFER_ENV,
+        TRACE_DIR_ENV,
+        TRACE_ENV,
+        TRACE_SAMPLE_ENV,
+        TraceConfig,
+    )
+
+    raw_buffer = os.environ.get(TRACE_BUFFER_ENV, "")
+    if raw_buffer:
+        try:
+            buf = int(raw_buffer)
+        except ValueError:
+            return False, (
+                f"{TRACE_BUFFER_ENV}={raw_buffer!r} is not an integer — the "
+                "Manager silently falls back to the default ring size"
+            )
+        if buf < 16:
+            return None, (
+                f"{TRACE_BUFFER_ENV}={buf} below the floor of 16 — clamped; "
+                "a ring that small drops most of a step's spans"
+            )
+    raw_sample = os.environ.get(TRACE_SAMPLE_ENV, "")
+    if raw_sample:
+        try:
+            sample = float(raw_sample)
+        except ValueError:
+            return False, (
+                f"{TRACE_SAMPLE_ENV}={raw_sample!r} is not a float — the "
+                "Manager silently falls back to sampling every step"
+            )
+        if not 0.0 <= sample <= 1.0:
+            return None, (
+                f"{TRACE_SAMPLE_ENV}={sample} outside [0, 1] — clamped"
+            )
+    cfg = TraceConfig.from_env()
+    if cfg.dump_dir:
+        try:
+            os.makedirs(cfg.dump_dir, exist_ok=True)
+            probe = os.path.join(cfg.dump_dir, ".doctor_probe")
+            with open(probe, "w") as f:
+                f.write("ok")
+            os.remove(probe)
+        except OSError as e:
+            return False, (
+                f"{TRACE_DIR_ENV}={cfg.dump_dir!r} not writable ({e}) — "
+                "postmortem trace auto-dumps will be lost"
+            )
+    detail = (
+        f"enabled={cfg.enabled} buffer={cfg.buffer} sample={cfg.sample} "
+        f"dump_dir={cfg.dump_dir or '(flight-recorder fallback)'}"
+    )
+    if not cfg.enabled:
+        return None, f"tracing disabled ({TRACE_ENV}); {detail}"
+    return True, detail
+
+
+def _parse_prometheus(text: str) -> "dict[str, float]":
+    """Minimal exposition-format parse: series name (labels folded in) ->
+    value. Raises on malformed lines, which is the point of the probe."""
+    series = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        series[name] = float(value)
+    return series
+
+
+def check_metrics_endpoints() -> Result:
+    """Loopback /metrics probes of BOTH exporters: the lighthouse's native
+    endpoint (beside /health) and the manager-side Python MetricsServer.
+    Each response must parse as Prometheus text and carry its signature
+    series — a scrape config written against docs/observability.md works."""
+    try:
+        import urllib.request
+
+        from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+        from torchft_tpu.observability import MetricsRegistry, MetricsServer
+
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+            health={"mode": "observe"},
+        )
+        try:
+            client = LighthouseClient(f"127.0.0.1:{lh.port}", connect_timeout=5.0)
+            client.heartbeat(
+                "doctor", timeout=5.0,
+                telemetry={"step": 1, "step_s": 0.1, "wire_s": 0.01},
+            )
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{lh.port}/metrics", timeout=5.0
+            ) as resp:
+                lh_series = _parse_prometheus(resp.read().decode())
+        finally:
+            lh.shutdown()
+        if "torchft_lighthouse_fleet_size" not in lh_series:
+            return False, (
+                "lighthouse /metrics parsed but is missing "
+                f"torchft_lighthouse_fleet_size: {sorted(lh_series)[:5]}..."
+            )
+        registry = MetricsRegistry()
+        registry.gauge_set("torchft_doctor_probe", 1.0, "Doctor loopback.")
+        server = MetricsServer(registry, port=0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5.0
+            ) as resp:
+                mgr_series = _parse_prometheus(resp.read().decode())
+        finally:
+            server.shutdown()
+        if mgr_series.get("torchft_doctor_probe") != 1.0:
+            return False, f"manager-side /metrics lost the probe gauge: {mgr_series}"
+        return True, (
+            f"lighthouse /metrics ({len(lh_series)} series) + manager "
+            f"/metrics both parse as Prometheus text"
+        )
+    except Exception as e:  # noqa: BLE001
+        return False, f"/metrics probe failed: {e}"
+
+
 CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("native", check_native),
     ("accelerator", check_accelerator),
@@ -327,7 +459,9 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("retry-env", check_retry_env),
     ("health-env", check_health_env),
     ("compress-env", check_compress_env),
+    ("trace-env", check_trace_env),
     ("health-http", check_health_endpoint),
+    ("metrics-http", check_metrics_endpoints),
     ("heal", check_heal_roundtrip),
 ]
 

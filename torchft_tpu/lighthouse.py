@@ -6,7 +6,8 @@ Run one lighthouse per job::
     python -m torchft_tpu.lighthouse --min-replicas 2 --bind 0.0.0.0:29510
 
 Workers point at it via ``TORCHFT_LIGHTHOUSE=http://host:port``. The same
-port serves the HTML dashboard (``/``), ``/status`` JSON, and per-replica
+port serves the HTML dashboard (``/``), ``/status`` JSON, the ``/health``
+ledger JSON, Prometheus-text ``/metrics``, and per-replica
 ``POST /replica/{id}/kill``.
 """
 
@@ -38,6 +39,14 @@ def main(argv: "list[str] | None" = None) -> None:
     parser.add_argument(
         "--heartbeat-timeout-ms", "--heartbeat_timeout_ms", type=int, default=5000
     )
+    parser.add_argument(
+        "--history",
+        default="",
+        metavar="PATH",
+        help="append-only JSONL of quorum transitions / heals / health "
+        "events / telemetry snapshots; replay with "
+        "`python -m torchft_tpu.trace history PATH` (default: disabled)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -47,6 +56,7 @@ def main(argv: "list[str] | None" = None) -> None:
         join_timeout_ms=args.join_timeout_ms,
         quorum_tick_ms=args.quorum_tick_ms,
         heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+        history_path=args.history,
     )
     logging.info("lighthouse listening at %s", server.address())
 

@@ -32,6 +32,7 @@ import uuid
 import weakref
 from concurrent.futures import ThreadPoolExecutor
 from datetime import timedelta
+from pathlib import Path
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
@@ -50,8 +51,12 @@ from torchft_tpu.observability import (
     ALLREDUCE_PIPELINE_PHASE,
     COMMIT_EVENTS,
     HEALTH_EVENTS,
+    METRICS_PORT_ENV,
     TIMING_EVENTS,
+    MetricsRegistry,
+    MetricsServer,
     emit_event_async,
+    get_event_drain,
     log_error_event,
     log_quorum_event,
     trace_span,
@@ -64,6 +69,7 @@ from torchft_tpu.ops.quantization import (
     resolve_compress_mode,
 )
 from torchft_tpu.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.tracing import TRACE_BUFFER_ENV, SpanRecorder, TraceConfig
 from torchft_tpu.work import (
     DummyWork,
     Future,
@@ -95,6 +101,23 @@ STREAM_BUCKETS_ENV = "TORCHFT_STREAM_BUCKETS"
 # wire compression for streamed buckets ("off" | "fp8" | "int8"): resolved
 # in ops/quantization.resolve_compress_mode (env TORCHFT_COMPRESS >
 # constructor > "off") so doctor.py validates the same way the Manager does
+
+# timings() keys that are cumulative counters (rendered as Prometheus
+# `_total` counters by _refresh_metrics); every other numeric key is a
+# last-value gauge
+_COUNTER_TIMINGS = frozenset(
+    {
+        "heal_attempts",
+        "heal_failovers",
+        "rpc_retries",
+        "chunk_crc_failures",
+        "collective_reroute",
+        "ejections",
+        "readmissions",
+        "dropped_events",
+        "trace_dropped",
+    }
+)
 
 
 def _to_seconds(t: "float | timedelta") -> float:
@@ -188,6 +211,8 @@ class Manager:
         bucket_cap_bytes: Optional[int] = None,
         stream_buckets: Optional[bool] = None,
         compress: Optional[str] = None,
+        tracing: Optional[bool] = None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -459,6 +484,40 @@ class Manager:
 
         self._logger = _ManagerLogger(self, self._replica_id, group_rank)
 
+        # fleet tracing plane: per-replica span recorder (tracing.py).
+        # Constructor arg > TORCHFT_TRACE env (default on); spans are O(1)
+        # dict appends behind one lock, so the default-on cost holds the
+        # bench.py --tracing <1% line.
+        trace_cfg = TraceConfig.from_env()
+        if tracing is not None:
+            trace_cfg.enabled = bool(tracing)
+        self._tracer = SpanRecorder(self._replica_id, trace_cfg)
+        # one-shot latch for the dropped_events warning (satellite: the
+        # drain's drop count used to be silently discarded)
+        self._dropped_events_warned = False
+
+        # manager-side /metrics: constructor arg > TORCHFT_METRICS_PORT
+        # env; absent/empty = no server. Histograms are fed at record time
+        # (_record_timing); gauges/counters sync from timings() and
+        # wire_stats() only when a scrape actually arrives (refresh hook).
+        self._metrics_registry: Optional[MetricsRegistry] = None
+        self._metrics_server: Optional[MetricsServer] = None
+        env_metrics = os.environ.get(METRICS_PORT_ENV, "")
+        if metrics_port is None and env_metrics != "":
+            try:
+                metrics_port = int(env_metrics)
+            except ValueError:
+                self._logger.warning(
+                    f"ignoring invalid {METRICS_PORT_ENV}={env_metrics!r}"
+                )
+        if metrics_port is not None:
+            self._metrics_registry = MetricsRegistry()
+            self._metrics_server = MetricsServer(
+                self._metrics_registry,
+                port=metrics_port,
+                refresh=self._refresh_metrics,
+            )
+
     # ------------------------------------------------------------- state fns
     def register_state_dict_fn(
         self,
@@ -603,15 +662,16 @@ class Manager:
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
         try:
-            quorum = self._client._quorum(
-                group_rank=self._group_rank,
-                step=self._step,
-                checkpoint_metadata=self._checkpoint_transport.metadata(),
-                shrink_only=shrink_only,
-                timeout=quorum_timeout,
-                init_sync=self._init_sync,
-                commit_failures=self._commit_failures,
-            )
+            with self._tracer.span("quorum_rpc", cat="quorum"):
+                quorum = self._client._quorum(
+                    group_rank=self._group_rank,
+                    step=self._step,
+                    checkpoint_metadata=self._checkpoint_transport.metadata(),
+                    shrink_only=shrink_only,
+                    timeout=quorum_timeout,
+                    init_sync=self._init_sync,
+                    commit_failures=self._commit_failures,
+                )
         except Exception as e:  # noqa: BLE001
             self._logger.exception(f"quorum RPC failed: {e}")
             self.report_error(e)
@@ -619,6 +679,7 @@ class Manager:
 
         self._num_replicas = quorum.replica_world_size
         self._bump_metric("quorums")
+        self._tracer.set_context(quorum_id=quorum.quorum_id, step=self._step)
 
         # Participation (reference: manager.py:671-690): async quorum means
         # healing replicas sit this step out, so the participating world is
@@ -666,7 +727,8 @@ class Manager:
                 # state returns that swap as a commit callable which the
                 # main thread applies at the next safe point
                 t_prep = time.perf_counter()
-                with trace_span("torchft::manager::_pg::prepare_configure"):
+                with trace_span("torchft::manager::_pg::prepare_configure"), \
+                        self._tracer.span("configure_prepare", cat="quorum"):
                     pg_commit = self._pg.prepare_configure(
                         store_prefixed_addr,
                         quorum.replica_rank,
@@ -687,7 +749,10 @@ class Manager:
                 # (no-op for address-based transports; PGTransport
                 # rendezvouses its recovery PG here). Distinct /recovery
                 # store namespace so the two meshes can't cross-wire.
-                with trace_span("torchft::manager::_transport::configure"):
+                with trace_span("torchft::manager::_transport::configure"), \
+                        self._tracer.span(
+                            "transport_configure", cat="quorum"
+                        ):
                     self._checkpoint_transport.configure(
                         f"{quorum.store_address}/torchft/{quorum.quorum_id}"
                         f"/recovery/{self._group_rank}",
@@ -731,7 +796,14 @@ class Manager:
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
                     t_send = time.perf_counter()
-                    with trace_span("torchft::manager::send_checkpoint"):
+                    with trace_span("torchft::manager::send_checkpoint"), \
+                            self._tracer.span(
+                                "heal_send",
+                                cat="heal",
+                                dst_ranks=list(
+                                    quorum.recover_dst_replica_ranks
+                                ),
+                            ):
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=quorum.recover_dst_replica_ranks,
                             step=quorum.max_step,
@@ -778,7 +850,8 @@ class Manager:
                     assert quorum.recover_src_replica_rank is not None
                     self._bump_counter("heal_attempts")
                     t_recv = time.perf_counter()
-                    with trace_span("torchft::manager::recv_checkpoint"):
+                    with trace_span("torchft::manager::recv_checkpoint"), \
+                            self._tracer.span("heal_recv", cat="heal"):
                         self._pending_state_dict = self._recv_checkpoint(quorum)
                     self._record_timing(
                         "heal_recv_s", time.perf_counter() - t_recv
@@ -848,6 +921,7 @@ class Manager:
         }.get(kind)
         if counter is not None:
             self._bump_counter(counter)
+        self._tracer.instant(kind, cat="heal", **fields)
         from torchft_tpu.flight_recorder import recorder
 
         recorder.record(
@@ -881,14 +955,19 @@ class Manager:
             except Exception:
                 # every candidate peer exhausted within the heal budget:
                 # dump the ring buffer NOW, while the heal_retry/
-                # heal_failover breadcrumbs are still in it
+                # heal_failover breadcrumbs are still in it. The tag
+                # carries (replica, step, reason) so a same-second eject
+                # dump can never overwrite this one, and the span ring
+                # dumps beside it for the fleet-timeline view.
                 from torchft_tpu.flight_recorder import recorder
 
-                recorder.dump(
+                fr_path = recorder.dump(
                     reason="heal_exhausted",
                     quorum_id=quorum.quorum_id,
-                    tag=f"{self._replica_id}_{self._group_rank}",
+                    tag=f"{self._replica_id}_{self._group_rank}"
+                    f"_s{quorum.max_step}_heal_exhausted",
                 )
+                self._auto_dump_trace("heal_exhausted", fr_path)
                 raise
         self._logger.info(
             f"healing required, fetching metadata from "
@@ -936,7 +1015,8 @@ class Manager:
             return
         t0 = time.perf_counter()
         try:
-            with trace_span("torchft::manager::configure_commit"):
+            with trace_span("torchft::manager::configure_commit"), \
+                    self._tracer.span("configure_commit", cat="quorum"):
                 commit()
         except Exception as e:  # noqa: BLE001
             # force the next quorum cycle to re-run prepare+commit even if
@@ -1729,9 +1809,143 @@ class Manager:
         with self._metrics_lock:
             return dict(self._metrics)
 
+    # ------------------------------------------------------------ tracing
+    @property
+    def tracer(self) -> SpanRecorder:
+        """This replica's span recorder (see :mod:`torchft_tpu.tracing`)."""
+        return self._tracer
+
+    def dump_trace(self, path: "str | Path | None" = None) -> Optional[Path]:
+        """Write the span ring as a merge-ready JSON dump and return its
+        path (None when no destination is configured — set
+        ``TORCHFT_TRACE_DIR`` or pass a path). Feed one dump per replica
+        to ``python -m torchft_tpu.trace merge`` for the fleet timeline."""
+        return self._tracer.dump(path)
+
+    def _auto_dump_trace(self, reason: str, fr_path: Optional[Path]) -> None:
+        """Drop the span ring next to a flight-recorder dump so the two
+        postmortem artifacts travel together (same directory, matching
+        reason suffix); falls back to the default trace destination when
+        the FR dump itself was disabled. Never raises."""
+        try:
+            path = None
+            if fr_path is not None:
+                path = Path(fr_path).parent / (
+                    f"trace_{self._replica_id}_{self._group_rank}"
+                    f"_s{self._step}_{reason}.json"
+                )
+            out = self._tracer.dump(path)
+            if out is not None:
+                self._logger.warning(f"span ring dumped to {out} ({reason})")
+        except Exception:  # noqa: BLE001 — postmortem path must not raise
+            self._logger.exception("trace auto-dump failed")
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """Bound TCP port of the Prometheus ``/metrics`` endpoint (None
+        when not serving; enable via ``metrics_port=`` or
+        ``TORCHFT_METRICS_PORT``)."""
+        return (
+            self._metrics_server.port
+            if self._metrics_server is not None
+            else None
+        )
+
+    def _refresh_metrics(self) -> None:
+        """Scrape-time sync of gauges/counters into the Prometheus
+        registry (the MetricsServer calls this before each render).
+        Histograms fill at :meth:`_record_timing` write time; everything
+        here is a last-value gauge or an absolute cumulative counter, so
+        re-rendering per scrape can't double-book."""
+        reg = self._metrics_registry
+        if reg is None:
+            return
+        for name, value in self.timings().items():
+            if not isinstance(value, (int, float)):
+                continue
+            if name in _COUNTER_TIMINGS:
+                reg.counter_set(
+                    f"torchft_manager_{name}_total",
+                    float(value),
+                    f"Cumulative {name} (Manager.timings()).",
+                )
+            else:
+                reg.gauge_set(
+                    f"torchft_manager_{name}",
+                    float(value),
+                    f"Last-value {name} (Manager.timings()).",
+                )
+        for name, value in self.metrics().items():
+            reg.counter_set(
+                f"torchft_manager_{name}_total",
+                float(value),
+                f"Lifetime {name} (Manager.metrics()).",
+            )
+        reg.gauge_set(
+            "torchft_manager_step", float(self._step), "Current manager step."
+        )
+        reg.gauge_set(
+            "torchft_manager_quorum_id",
+            float(self._quorum_id),
+            "Quorum id of the current process-group generation.",
+        )
+        tstats = self._tracer.stats()
+        reg.counter_set(
+            "torchft_manager_trace_spans_total",
+            tstats["recorded"],
+            "Spans recorded into the trace ring since construction.",
+        )
+        try:
+            wire_fn = getattr(self._pg, "wire_stats", None)
+            wire = wire_fn() if wire_fn is not None else {}
+        except Exception:  # noqa: BLE001
+            wire = {}
+        for name, value in (wire or {}).items():
+            if not isinstance(value, (int, float)):
+                continue
+            if name.startswith("bytes_"):
+                reg.counter_set(
+                    f"torchft_manager_wire_{name}_total",
+                    float(value),
+                    f"Cumulative transport {name} across PG generations.",
+                )
+            else:
+                reg.gauge_set(
+                    f"torchft_manager_wire_{name}",
+                    float(value),
+                    f"Transport {name} (ProcessGroup.wire_stats()).",
+                )
+        if self._manager is not None:
+            try:
+                skew_fn = getattr(self._manager, "clock_skew", None)
+                skew = skew_fn() if skew_fn is not None else {}
+            except Exception:  # noqa: BLE001
+                skew = {}
+            if skew:
+                reg.gauge_set(
+                    "torchft_manager_clock_skew_ms",
+                    float(skew.get("skew_ms", 0.0)),
+                    "Estimated clock skew vs the lighthouse "
+                    "(best = minimum-RTT heartbeat sample).",
+                )
+                reg.gauge_set(
+                    "torchft_manager_clock_skew_rtt_ms",
+                    float(skew.get("rtt_ms", 0.0)),
+                    "Heartbeat RTT of the best skew sample.",
+                )
+
     def _record_timing(self, name: str, value: float) -> None:
         with self._metrics_lock:
             self._timings[name] = value
+        # histograms accumulate at write time (the scrape-time refresh only
+        # syncs last-value gauges and cumulative counters — re-observing a
+        # last-value snapshot per scrape would double-book the same phase)
+        if self._metrics_registry is not None and name.endswith("_s"):
+            self._metrics_registry.observe(
+                f"torchft_manager_{name[:-2]}_seconds",
+                value,
+                f"Manager {name[:-2]} phase wall-clock (seconds).",
+            )
 
     def _bump_counter(self, name: str, n: float = 1.0) -> None:
         """Increment a cumulative resilience counter in timings()."""
@@ -1743,6 +1957,9 @@ class Manager:
         control-plane blip shorter than the quorum timeout degrades to a
         slower step, and this is the audit trail that says so."""
         self._bump_counter("rpc_retries")
+        self._tracer.instant(
+            "rpc_retry", cat="rpc", method=method, attempt=attempt
+        )
         self._logger.warning(
             f"RPC {method} retrying (attempt {attempt}) after {exc!r}"
         )
@@ -1763,6 +1980,9 @@ class Manager:
         ring: a mid-collective link failure degraded to a re-routed slow
         step instead of a swallowed one, and this is the audit trail."""
         self._bump_counter("collective_reroute")
+        self._tracer.instant(
+            "reroute", cat="rpc", link=list(pair), attempt=attempt
+        )
         self._logger.warning(
             f"collective re-routed around dead link {pair} "
             f"(attempt {attempt})"
@@ -1838,6 +2058,15 @@ class Manager:
         stats = _pipeline_overlap_stats(marks)
         with self._metrics_lock:
             self._timings.update(stats)
+        for i, mark in enumerate(marks):
+            for stage in ("pack", "wire", "unpack"):
+                span = mark.get(stage)
+                if span is None:
+                    continue
+                t0_pc, t1_pc = span
+                self._tracer.record_rel(
+                    stage, cat="allreduce", t0_pc=t0_pc, t1_pc=t1_pc, bucket=i
+                )
         self._log_timing_snapshot(ALLREDUCE_PIPELINE_PHASE)
 
     def timings(self) -> Dict[str, float]:
@@ -1867,9 +2096,31 @@ class Manager:
         ``health_state`` (0=ok 1=warn 2=ejected 3=probation),
         ``straggler_score`` (quorum-relative modified z-score), and the
         cumulative ``ejections`` / ``readmissions`` counts. All four are
-        seeded to 0.0 at construction."""
+        seeded to 0.0 at construction.
+
+        ``dropped_events`` / ``trace_dropped`` count observability losses:
+        telemetry events shed by the bounded async drain under
+        saturation, and spans overwritten in the trace ring. Both planes
+        are deliberately lossy (they must never stall the step), so these
+        are the honesty counters — nonzero means the record is
+        incomplete, warned once per Manager."""
         with self._metrics_lock:
-            return dict(self._timings)
+            out = dict(self._timings)
+        out["dropped_events"] = float(get_event_drain().dropped)
+        out["trace_dropped"] = self._tracer.stats()["dropped"]
+        if (
+            out["dropped_events"] + out["trace_dropped"] > 0
+            and not self._dropped_events_warned
+        ):
+            self._dropped_events_warned = True
+            self._logger.warning(
+                f"observability queues saturated: "
+                f"{int(out['dropped_events'])} telemetry event(s) and "
+                f"{int(out['trace_dropped'])} span(s) dropped so far — "
+                f"timings/trace records are incomplete (raise "
+                f"{TRACE_BUFFER_ENV} or reduce scrape/step rate)"
+            )
+        return out
 
     # -------------------------------------------------------- healthwatch
     def set_telemetry_transform(
@@ -1908,8 +2159,22 @@ class Manager:
 
         Must never raise — telemetry is advisory and this sits on the
         commit path."""
+        self._tracer.set_context(step=self._step)
         if self._manager is None:
             return
+        # fold the beat loop's latest skew estimate into the tracer so the
+        # next export/auto-dump is merge-ready; pure local state, no RPC
+        try:
+            skew_fn = getattr(self._manager, "clock_skew", None)
+            if skew_fn is not None:
+                skew = skew_fn() or {}
+                self._tracer.set_skew(
+                    skew.get("skew_ms", 0.0),
+                    skew.get("rtt_ms", 0.0),
+                    skew.get("samples", 0),
+                )
+        except Exception:  # noqa: BLE001 — advisory plane, commit path
+            pass
         now = time.perf_counter()
         last, self._last_commit_t = self._last_commit_t, now
         prev_committed = self._last_vote_committed
@@ -1990,6 +2255,24 @@ class Manager:
             replica=self._replica_id,
             group_rank=self._group_rank,
         )
+        self._tracer.instant(
+            kind,
+            cat="health",
+            state=state,
+            prev_state=prev,
+            score=summary.get("score", 0.0),
+        )
+        if kind == "eject":
+            # the lighthouse just cut this replica out of the quorum: dump
+            # both postmortem artifacts NOW, while the straggler evidence
+            # (slow buckets, retried RPCs) is still in the rings
+            fr_path = recorder.dump(
+                reason="eject",
+                quorum_id=self._quorum_id,
+                tag=f"{self._replica_id}_{self._group_rank}"
+                f"_s{self._step}_eject",
+            )
+            self._auto_dump_trace("eject", fr_path)
 
     def _log_timing_snapshot(self, phase: str) -> None:
         try:
@@ -2035,7 +2318,8 @@ class Manager:
         recorder.dump(
             reason="manager_error",
             quorum_id=self._quorum_id,
-            tag=f"{self._replica_id}_{self._group_rank}",
+            tag=f"{self._replica_id}_{self._group_rank}"
+            f"_s{self._step}_manager_error",
         )
         log_error_event(
             replica_id=self._replica_id,
@@ -2135,12 +2419,15 @@ class Manager:
         # frame (coordination.py): the steady-state step is this one RPC
         # round-trip plus the collective
         t_rpc = time.perf_counter()
-        should_commit = self._vote_client.should_commit(
-            self._group_rank,
-            self._step,
-            local_should_commit,
-            timeout=_to_seconds(timeout) if timeout is not None else self._timeout,
-        )
+        with self._tracer.span(
+            "commit_vote", cat="commit", local=local_should_commit
+        ):
+            should_commit = self._vote_client.should_commit(
+                self._group_rank,
+                self._step,
+                local_should_commit,
+                timeout=_to_seconds(timeout) if timeout is not None else self._timeout,
+            )
         rpc_s = time.perf_counter() - t_rpc
         # per-step outcome at DEBUG: the False cases already warn above /
         # in the retry path, and the commit event below carries the full
@@ -2295,6 +2582,9 @@ class Manager:
 
     # ------------------------------------------------------------ lifecycle
     def shutdown(self, wait: bool = True) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server = None
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:
             self._manager.shutdown()

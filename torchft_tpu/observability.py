@@ -312,3 +312,157 @@ def trace_span(name: str) -> Iterator[None]:
         return
     with TraceAnnotation(name):
         yield
+
+
+# ---------------------------------------------------------------- /metrics
+# Manager-side Prometheus text exposition (the lighthouse serves its own
+# /metrics natively beside /health). One registry per Manager: timing
+# splits as histograms (fed by Manager._record_timing at write time),
+# counters/gauges synced from Manager.timings() + wire_stats() at scrape
+# time via the refresh hook.
+
+METRICS_PORT_ENV = "TORCHFT_METRICS_PORT"
+
+# Exponential-ish bucket bounds in SECONDS for phase-timing histograms:
+# control-plane phases span ~100us (vote RPC on loopback) to tens of
+# seconds (a full heal), so fixed linear buckets would waste either end.
+DEFAULT_TIME_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class MetricsRegistry:
+    """Thread-safe registry rendering Prometheus text exposition 0.0.4."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, Tuple[float, str]] = {}
+        self._counters: Dict[str, Tuple[float, str]] = {}
+        # name -> (help, bucket bounds, per-bucket counts, sum, count)
+        self._hists: Dict[str, Any] = {}
+
+    def gauge_set(self, name: str, value: float, help_: str = "") -> None:
+        with self._lock:
+            self._gauges[name] = (float(value), help_)
+
+    def counter_set(self, name: str, value: float, help_: str = "") -> None:
+        """Set a counter's ABSOLUTE cumulative value (Manager counters are
+        already cumulative; re-counting them here would double-book)."""
+        with self._lock:
+            self._counters[name] = (float(value), help_)
+
+    def observe(
+        self,
+        name: str,
+        value: float,
+        help_: str = "",
+        buckets: Tuple[float, ...] = DEFAULT_TIME_BUCKETS,
+    ) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = [help_, tuple(buckets), [0] * (len(buckets) + 1), 0.0, 0]
+                self._hists[name] = h
+            bounds = h[1]
+            i = len(bounds)
+            for j, b in enumerate(bounds):
+                if value <= b:
+                    i = j
+                    break
+            h[2][i] += 1
+            h[3] += float(value)
+            h[4] += 1
+
+    def render(self) -> str:
+        out = []
+        with self._lock:
+            for name in sorted(self._gauges):
+                value, help_ = self._gauges[name]
+                if help_:
+                    out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name} {value}")
+            for name in sorted(self._counters):
+                value, help_ = self._counters[name]
+                if help_:
+                    out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} counter")
+                out.append(f"{name} {value}")
+            for name in sorted(self._hists):
+                help_, bounds, counts, total, n = self._hists[name]
+                if help_:
+                    out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} histogram")
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    out.append(f'{name}_bucket{{le="{b}"}} {cum}')
+                cum += counts[-1]
+                out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                out.append(f"{name}_sum {total}")
+                out.append(f"{name}_count {n}")
+        return "\n".join(out) + "\n"
+
+
+class MetricsServer:
+    """Tiny threaded HTTP server exposing one registry at ``/metrics``.
+
+    ``refresh`` (optional) runs before each render — the Manager uses it
+    to sync timings()/wire_stats() into the registry only when someone
+    actually scrapes, keeping the training hot path untouched."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        refresh: Optional[Any] = None,
+    ) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry_ref = registry
+        refresh_ref = refresh
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    if refresh_ref is not None:
+                        refresh_ref()
+                    body = registry_ref.render().encode()
+                except Exception:  # noqa: BLE001 — scrape must not crash
+                    self.send_error(500)
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:  # silence per-scrape
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="torchft_metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def shutdown(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass

@@ -1,0 +1,73 @@
+"""Fleet trace tooling CLI.
+
+Merge N replicas' span dumps (written by ``SpanRecorder.dump`` — auto on
+heal_exhausted/eject next to the flight-recorder dump, or on demand via
+``Manager.dump_trace``) into one skew-corrected Chrome-trace JSON:
+
+    python -m torchft_tpu.trace merge fleet.json dump_r0.json dump_r1.json ...
+
+Globs work through the shell; open the output in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing. Each replica renders as a
+process row (labelled with its estimated clock skew vs the lighthouse) and
+each span category (quorum / commit / heal / allreduce / rpc) as a thread
+row; all timestamps sit on the lighthouse's clock.
+
+Also summarizes a recorded-history JSONL (the lighthouse's
+``history_path`` store) through the canonical Python fold:
+
+    python -m torchft_tpu.trace history lighthouse_history.jsonl
+
+See docs/observability.md for the span taxonomy and the slow-step runbook.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from torchft_tpu.tracing import history_fold, merge_traces, parse_history
+
+
+def _usage() -> int:
+    sys.stderr.write(
+        "usage: python -m torchft_tpu.trace merge OUT.json DUMP.json"
+        " [DUMP.json ...]\n"
+        "       python -m torchft_tpu.trace history HISTORY.jsonl\n"
+    )
+    return 2
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        return _usage()
+    cmd, args = argv[0], argv[1:]
+    if cmd == "merge":
+        if len(args) < 2:
+            return _usage()
+        out_path, dump_paths = args[0], args[1:]
+        dumps = []
+        for p in dump_paths:
+            with open(p) as f:
+                dumps.append(json.load(f))
+        trace = merge_traces(dumps)
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+        n_spans = sum(len(d.get("spans", [])) for d in dumps)
+        print(
+            f"merged {len(dumps)} replica dumps / {n_spans} spans "
+            f"-> {out_path}"
+        )
+        return 0
+    if cmd == "history":
+        if len(args) != 1:
+            return _usage()
+        with open(args[0]) as f:
+            events = parse_history(f.read())
+        print(json.dumps(history_fold(events), indent=2, sort_keys=True))
+        return 0
+    return _usage()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,456 @@
+"""Fleet tracing plane: per-manager span recorder, skew-corrected
+Chrome-trace merge, and the recorded-history fold.
+
+The repo's telemetry was per-replica (Manager.timings(), flight-recorder
+breadcrumbs, /health) — useful for one process, useless for "which replica
+stalled bucket 7 of step 412" across a fleet. This module closes that gap:
+
+- :class:`SpanRecorder` — a bounded ring buffer of structured spans the
+  Manager records around its control-plane and wire phases (quorum /
+  prepare / commit, per-bucket pack / wire / unpack, heal chunks, RPC
+  retries, reroutes). Every span carries ``(quorum_id, step)`` and the
+  recorder's ``replica_id``, so spans from different replicas of the same
+  step correlate without a global clock. Recording is an O(1) dict append
+  behind one lock — cheap enough to stay on by default (the
+  ``bench.py --tracing`` gate holds the <1% line).
+- **Skew correction** — each export stamps the replica's clock-skew
+  estimate vs the lighthouse (``ManagerServer.clock_skew()``: the beat
+  loop's response ``server_ms`` against the RPC round-trip midpoint, best
+  = minimum-RTT sample). :func:`merge_traces` shifts every replica onto
+  the lighthouse's clock, so cross-replica ordering is correct within the
+  estimated-skew bound (~RTT/2 on a quiet network).
+- :func:`merge_traces` / ``python -m torchft_tpu.trace merge`` — N span
+  dumps in, one Chrome-trace JSON out (load in Perfetto or
+  chrome://tracing): one process row per replica, one thread row per span
+  category.
+- :func:`history_fold` — the canonical Python fold over the lighthouse's
+  recorded-history JSONL (quorum transitions / heals / health events /
+  telemetry snapshots). The native read path ``tft_history_replay``
+  (coordination.history_replay) computes the SAME summary; parity is
+  pinned by test, same convention as the healthwatch replay hooks. This
+  is the replay substrate the ROADMAP's adaptive policy engine consumes.
+
+Env knobs (read once per Manager via :meth:`TraceConfig.from_env`):
+
+- ``TORCHFT_TRACE``: ``1``/``0`` — master switch (default on).
+- ``TORCHFT_TRACE_BUFFER``: ring capacity in spans (default 4096).
+- ``TORCHFT_TRACE_SAMPLE``: fraction of steps traced, deterministic by
+  step hash so all replicas keep/drop the SAME steps (default 1.0).
+- ``TORCHFT_TRACE_DIR``: auto-dump directory; empty falls back next to
+  the flight-recorder dump path (``TORCHFT_FR_BASE_PATH``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Deque, Dict, Iterable, List, Optional
+
+TRACE_ENV = "TORCHFT_TRACE"
+TRACE_BUFFER_ENV = "TORCHFT_TRACE_BUFFER"
+TRACE_SAMPLE_ENV = "TORCHFT_TRACE_SAMPLE"
+TRACE_DIR_ENV = "TORCHFT_TRACE_DIR"
+
+_DEFAULT_BUFFER = 4096
+
+__all__ = [
+    "TraceConfig",
+    "SpanRecorder",
+    "merge_traces",
+    "history_fold",
+    "parse_history",
+    "set_clock_offset_ms",
+    "clear_clock_offsets",
+]
+
+
+# --------------------------------------------------------------- test hooks
+# Injected per-replica clock offsets (event_injector.skew_clock): shifts the
+# recorder's own clock, which self-consistently shifts its estimated skew vs
+# the lighthouse by the same amount — exactly what a genuinely skewed host
+# looks like, so the merge-corrects-ordering test exercises the real path.
+_clock_offsets: Dict[str, float] = {}
+_clock_offsets_lock = threading.Lock()
+
+
+def set_clock_offset_ms(replica_id: str, offset_ms: float) -> None:
+    """TEST ONLY: pretend ``replica_id``'s wall clock runs ``offset_ms``
+    ahead of true time (matched exactly or by prefix, like
+    ``slow_replica``)."""
+    with _clock_offsets_lock:
+        _clock_offsets[replica_id] = float(offset_ms)
+
+
+def clear_clock_offsets() -> None:
+    with _clock_offsets_lock:
+        _clock_offsets.clear()
+
+
+def _offset_ms_for(replica_id: str) -> float:
+    with _clock_offsets_lock:
+        if not _clock_offsets:
+            return 0.0
+        if replica_id in _clock_offsets:
+            return _clock_offsets[replica_id]
+        for key, off in _clock_offsets.items():
+            if replica_id.startswith(key):
+                return off
+    return 0.0
+
+
+# ------------------------------------------------------------------- config
+@dataclass
+class TraceConfig:
+    enabled: bool = True
+    buffer: int = _DEFAULT_BUFFER
+    sample: float = 1.0
+    dump_dir: str = ""
+
+    @classmethod
+    def from_env(cls) -> "TraceConfig":
+        cfg = cls()
+        cfg.enabled = os.environ.get(TRACE_ENV, "1").strip() not in (
+            "0", "off", "false", "no",
+        )
+        try:
+            cfg.buffer = max(16, int(os.environ.get(TRACE_BUFFER_ENV, "")))
+        except ValueError:
+            cfg.buffer = _DEFAULT_BUFFER
+        try:
+            cfg.sample = min(
+                1.0, max(0.0, float(os.environ.get(TRACE_SAMPLE_ENV, "")))
+            )
+        except ValueError:
+            cfg.sample = 1.0
+        cfg.dump_dir = os.environ.get(TRACE_DIR_ENV, "")
+        return cfg
+
+
+def step_sampled(step: int, sample: float) -> bool:
+    """Deterministic per-step sampling decision, identical on every
+    replica (Knuth multiplicative hash — no RNG, no cross-replica skew in
+    WHICH steps are kept, so sampled steps still merge into full fleet
+    timelines)."""
+    if sample >= 1.0:
+        return True
+    if sample <= 0.0:
+        return False
+    return ((step * 2654435761) % (1 << 32)) / float(1 << 32) < sample
+
+
+# ----------------------------------------------------------------- recorder
+class _SpanHandle:
+    """Context manager for an in-progress span; records on exit."""
+
+    __slots__ = ("_rec", "name", "cat", "args", "_t0_us", "_t0_pc")
+
+    def __init__(self, rec: "SpanRecorder", name: str, cat: str, args: dict):
+        self._rec = rec
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self) -> "_SpanHandle":
+        self._t0_us = self._rec._now_us()
+        self._t0_pc = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dur_us = int((time.perf_counter() - self._t0_pc) * 1e6)
+        self._rec._append(
+            self.name, self.cat, self._t0_us, max(dur_us, 1), self.args
+        )
+
+
+class SpanRecorder:
+    """Bounded ring of structured spans for ONE replica.
+
+    Thread-safe; every mutator is a no-op when disabled, so Manager call
+    sites never branch. Timestamps are epoch microseconds from the local
+    wall clock (plus any injected test offset); the skew estimate stamped
+    into :meth:`export` is what lets the merger move them onto the
+    lighthouse's clock.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        config: Optional[TraceConfig] = None,
+    ) -> None:
+        self._replica_id = replica_id
+        self._config = config if config is not None else TraceConfig.from_env()
+        self._spans: Deque[Dict[str, Any]] = deque(maxlen=self._config.buffer)
+        self._lock = threading.Lock()
+        self._quorum_id: Optional[int] = None
+        self._step: Optional[int] = None
+        self._step_on = True  # sampling decision for the current step
+        self._skew_ms = 0.0
+        self._rtt_ms = 0.0
+        self._skew_samples = 0
+        self._dropped = 0
+        self._recorded = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._config.enabled
+
+    @property
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    # ------------------------------------------------------------- context
+    def set_context(
+        self,
+        quorum_id: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> None:
+        """Update the ``(quorum_id, step)`` stamped into subsequent spans;
+        re-evaluates the per-step sampling decision on a step change."""
+        with self._lock:
+            if quorum_id is not None:
+                self._quorum_id = quorum_id
+            if step is not None and step != self._step:
+                self._step = step
+                self._step_on = step_sampled(step, self._config.sample)
+
+    def set_skew(
+        self, skew_ms: float, rtt_ms: float = 0.0, samples: int = 0
+    ) -> None:
+        """Feed the latest heartbeat-derived skew estimate
+        (``ManagerServer.clock_skew()``). An injected test clock offset
+        shifts the estimate too — a host whose clock runs fast is fast in
+        both its span stamps and its measured skew."""
+        with self._lock:
+            self._skew_ms = float(skew_ms)
+            self._rtt_ms = float(rtt_ms)
+            self._skew_samples = int(samples)
+
+    # ----------------------------------------------------------- recording
+    def _now_us(self) -> int:
+        off = _offset_ms_for(self._replica_id)
+        return time.time_ns() // 1000 + int(off * 1000)
+
+    def _append(
+        self, name: str, cat: str, ts_us: int, dur_us: int, args: dict
+    ) -> None:
+        if not self._config.enabled:
+            return
+        with self._lock:
+            if not self._step_on:
+                return
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
+            self._recorded += 1
+            span: Dict[str, Any] = {
+                "name": name,
+                "cat": cat,
+                "ts_us": ts_us,
+                "dur_us": dur_us,
+                "quorum_id": self._quorum_id,
+                "step": self._step,
+            }
+            if args:
+                span["args"] = args
+            self._spans.append(span)
+
+    def span(self, name: str, cat: str = "step", **args: Any) -> _SpanHandle:
+        """``with tracer.span("quorum", cat="quorum"): ...``"""
+        return _SpanHandle(self, name, cat, args)
+
+    def record(
+        self,
+        name: str,
+        cat: str,
+        t0_us: int,
+        t1_us: int,
+        **args: Any,
+    ) -> None:
+        """Record a completed interval given absolute epoch-us endpoints."""
+        self._append(name, cat, int(t0_us), max(int(t1_us - t0_us), 1), args)
+
+    def record_rel(
+        self,
+        name: str,
+        cat: str,
+        t0_pc: float,
+        t1_pc: float,
+        **args: Any,
+    ) -> None:
+        """Record a completed interval given ``time.perf_counter()``
+        endpoints (the pipeline marks' native form): anchored to the wall
+        clock at call time, so recently-finished intervals land within
+        scheduler noise of their true wall positions."""
+        anchor_us = self._now_us()
+        anchor_pc = time.perf_counter()
+        t0_us = anchor_us + int((t0_pc - anchor_pc) * 1e6)
+        t1_us = anchor_us + int((t1_pc - anchor_pc) * 1e6)
+        self._append(name, cat, t0_us, max(t1_us - t0_us, 1), args)
+
+    def instant(self, name: str, cat: str, **args: Any) -> None:
+        """Zero-duration marker (RPC retry, reroute, heal chunk events)."""
+        self._append(name, cat, self._now_us(), 1, args)
+
+    # ------------------------------------------------------------- exports
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "spans": float(len(self._spans)),
+                "recorded": float(self._recorded),
+                "dropped": float(self._dropped),
+            }
+
+    def export(self) -> Dict[str, Any]:
+        """One replica's span dump: merge-ready, skew-stamped."""
+        with self._lock:
+            return {
+                "replica_id": self._replica_id,
+                "clock": "epoch_us",
+                "skew_ms": self._skew_ms + _offset_ms_for(self._replica_id),
+                "rtt_ms": self._rtt_ms,
+                "skew_samples": self._skew_samples,
+                "dropped": self._dropped,
+                "spans": list(self._spans),
+            }
+
+    def dump(self, path: "str | Path | None" = None) -> Optional[Path]:
+        """Write :meth:`export` as JSON; never raises (dumps run on
+        failure paths). Default location: ``TORCHFT_TRACE_DIR``, else next
+        to the flight-recorder base path, else None (disabled)."""
+        try:
+            if path is None:
+                base = self._config.dump_dir or os.environ.get(
+                    "TORCHFT_FR_BASE_PATH", ""
+                )
+                if not base:
+                    return None
+                d = Path(base) if self._config.dump_dir else Path(
+                    str(base) + "_traces"
+                )
+                d.mkdir(parents=True, exist_ok=True)
+                path = d / f"trace_{self._replica_id}_{time.time_ns()}.json"
+            path = Path(path)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(self.export(), f)
+            return path
+        except Exception:  # noqa: BLE001 — observability must not raise
+            return None
+
+
+# -------------------------------------------------------------------- merge
+def merge_traces(dumps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge N replicas' span dumps into one Chrome-trace JSON dict.
+
+    Each replica becomes a trace process (pid ordered by replica_id) and
+    each span category a thread within it; every timestamp is shifted by
+    ``-skew_ms`` onto the lighthouse's clock, so the same step's spans
+    from different replicas line up within the skew-estimate error.
+    Load the result in Perfetto / chrome://tracing.
+    """
+    events: List[Dict[str, Any]] = []
+    ordered = sorted(dumps, key=lambda d: str(d.get("replica_id", "")))
+    for pid, dump in enumerate(ordered):
+        rid = str(dump.get("replica_id", f"replica_{pid}"))
+        skew_us = float(dump.get("skew_ms", 0.0)) * 1000.0
+        events.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {
+                "name": f"{rid} (skew {dump.get('skew_ms', 0.0):+.3f}ms)"
+            },
+        })
+        tids: Dict[str, int] = {}
+        for span in dump.get("spans", []):
+            cat = str(span.get("cat", "step"))
+            tid = tids.setdefault(cat, len(tids))
+            args = dict(span.get("args", {}))
+            args["quorum_id"] = span.get("quorum_id")
+            args["step"] = span.get("step")
+            args["replica_id"] = rid
+            events.append({
+                "name": str(span.get("name", "?")),
+                "cat": cat,
+                "ph": "X",
+                "ts": float(span.get("ts_us", 0)) - skew_us,
+                "dur": max(float(span.get("dur_us", 1)), 1.0),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            })
+        for cat, tid in tids.items():
+            events.append({
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": cat},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------------------ history
+def parse_history(text: str) -> List[Dict[str, Any]]:
+    """Parse recorded-history JSONL content into an event list (blank
+    lines skipped) — the Python twin of the native read path's parser."""
+    events: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        events.append(json.loads(line))
+    return events
+
+
+def history_fold(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Canonical fold over history events -> summary.
+
+    MUST stay field-for-field identical to ``history_fold`` in
+    native/history.cc (the ``tft_history_replay`` summary); the parity
+    test drives the same JSONL through both.
+    """
+    kinds: Dict[str, int] = {}
+    replicas = set()
+    count = 0
+    last_quorum_id = -1
+    max_step = -1
+    first_ts = -1
+    last_ts = -1
+    for e in events:
+        count += 1
+        kind = str(e.get("kind", "unknown"))
+        kinds[kind] = kinds.get(kind, 0) + 1
+        if "replica_id" in e:
+            replicas.add(str(e["replica_id"]))
+        for rid in e.get("participants", []):
+            replicas.add(str(rid))
+        if "quorum_id" in e:
+            last_quorum_id = int(e["quorum_id"])
+        if "step" in e:
+            max_step = max(max_step, int(e["step"]))
+        if "to_step" in e:
+            max_step = max(max_step, int(e["to_step"]))
+        if "ts_ms" in e:
+            ts = int(e["ts_ms"])
+            if first_ts < 0:
+                first_ts = ts
+            last_ts = ts
+    return {
+        "count": count,
+        "kinds": kinds,
+        "replicas": sorted(replicas),
+        "quorum_transitions": kinds.get("quorum", 0),
+        "last_quorum_id": last_quorum_id,
+        "heals": kinds.get("heal", 0),
+        "ejections": kinds.get("eject", 0),
+        "readmissions": kinds.get("readmit", 0),
+        "warns": kinds.get("straggler_warn", 0),
+        "max_step": max_step,
+        "first_ts_ms": first_ts,
+        "last_ts_ms": last_ts,
+    }

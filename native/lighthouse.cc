@@ -94,10 +94,14 @@ void Lighthouse::quorum_tick_locked() {
   // the timeout so the dashboard still shows recently-dead replicas.
   auto now = Clock::now();
   for (auto it = state_.heartbeats.begin(); it != state_.heartbeats.end();) {
-    if (now - it->second > Millis(10 * opts_.heartbeat_timeout_ms))
+    if (now - it->second > Millis(10 * opts_.heartbeat_timeout_ms)) {
+      // Drop the history-dedup entry with the heartbeat: replica-id churn
+      // would otherwise grow history_telemetry_step_ without bound.
+      history_telemetry_step_.erase(it->first);
       it = state_.heartbeats.erase(it);
-    else
+    } else {
       ++it;
+    }
   }
   // Health ledger tick: probation -> readmission transitions (time-based)
   // and pruning on the same 10x horizon as the heartbeat map above.

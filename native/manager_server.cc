@@ -91,14 +91,17 @@ void ManagerServer::heartbeat_loop() {
         std::lock_guard<std::mutex> lk(telemetry_mu_);
         last_health_ = resp.get("health").dump();
       }
-      // Skew vs the lighthouse: server_ms against the round-trip midpoint.
-      // Keep the minimum-RTT sample's estimate — its midpoint assumption
+      // Skew vs the lighthouse: the round-trip midpoint against server_ms.
+      // Sign convention is replica-minus-lighthouse (positive when THIS
+      // clock runs ahead) — the trace merger subtracts skew_ms to move
+      // replica timestamps onto the lighthouse's clock. Keep the
+      // minimum-RTT sample's estimate — its midpoint assumption
       // (symmetric path) has the least queueing error (NTP's rule).
       if (resp.contains("server_ms")) {
         double server_ms =
             static_cast<double>(resp.get("server_ms").as_int());
         double rtt = static_cast<double>(t1 - t0);
-        double skew = server_ms - (static_cast<double>(t0 + t1) / 2.0);
+        double skew = (static_cast<double>(t0 + t1) / 2.0) - server_ms;
         std::lock_guard<std::mutex> lk(telemetry_mu_);
         skew_samples_ += 1;
         last_rtt_ms_ = rtt;

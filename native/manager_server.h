@@ -48,8 +48,10 @@ class ManagerServer {
   std::string health_json() const;  // "{}" until the first beat round-trips
 
   // Clock skew vs the lighthouse, estimated from heartbeat round-trips:
-  // the response's server_ms compared against the midpoint of this side's
-  // send/receive epoch times. The kept estimate is the one from the
+  // the midpoint of this side's send/receive epoch times minus the
+  // response's server_ms — replica-minus-lighthouse, positive when this
+  // host's clock runs ahead (merge_traces subtracts skew_ms to land on
+  // the lighthouse's clock). The kept estimate is the one from the
   // minimum-RTT beat (least queueing noise). JSON: {"skew_ms", "rtt_ms",
   // "last_skew_ms", "last_rtt_ms", "samples"}; samples=0 until the first
   // beat round-trips against a server_ms-aware lighthouse.

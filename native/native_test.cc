@@ -588,6 +588,45 @@ static void test_quorum_excluded_replica() {
   for (const auto& m : *met) CHECK(m.replica_id != "c");
 }
 
+// ------------------------------------------------------ heartbeat skew sign
+static void test_heartbeat_skew_sign() {
+  // Fake lighthouse answering the real beat loop with a fabricated
+  // server_ms 5s in the past: a lighthouse clock 5s BEHIND is this
+  // replica running 5s AHEAD, so the estimate must come out POSITIVE
+  // (replica-minus-lighthouse) — the sign merge_traces subtracts to land
+  // replica timestamps on the lighthouse's clock. A flipped estimator
+  // would double the skew error in merged fleet timelines.
+  RpcServer fake("127.0.0.1:0",
+                 [](const std::string& m, const Json&, TimePoint) {
+                   CHECK(m == "heartbeat");
+                   Json out = Json::object();
+                   out["server_ms"] = epoch_millis_now() - 5000;
+                   return out;
+                 });
+  ManagerOpts mo;
+  mo.replica_id = "skew_pin";
+  mo.lighthouse_addr = "127.0.0.1:" + std::to_string(fake.port());
+  mo.hostname = "127.0.0.1";
+  mo.bind = "127.0.0.1:0";
+  mo.heartbeat_interval_ms = 20;
+  ManagerServer mgr(mo);
+  double skew = 0.0, last = 0.0;
+  int64_t samples = 0;
+  for (int i = 0; i < 500 && samples < 1; ++i) {
+    Json j = Json::parse(mgr.clock_skew_json());
+    samples = j.get("samples").as_int();
+    skew = j.get("skew_ms").as_double();
+    last = j.get("last_skew_ms").as_double();
+    std::this_thread::sleep_for(Millis(10));
+  }
+  CHECK(samples >= 1);
+  // Loopback RTT is ~0; allow generous slack for a loaded CI host.
+  CHECK(skew > 4000.0 && skew < 6000.0);
+  CHECK(last > 4000.0 && last < 6000.0);
+  mgr.shutdown();
+  fake.shutdown();
+}
+
 int main() {
   test_quorum_fast_path();
   test_quorum_join_timeout_straggler();
@@ -607,6 +646,7 @@ int main() {
   test_wire_echo_and_timeout();
   test_kvstore();
   test_lighthouse_manager_e2e();
+  test_heartbeat_skew_sign();
   if (failures == 0) {
     std::printf("native_test: all tests passed\n");
     return 0;

@@ -363,3 +363,97 @@ class TestDashboard:
             assert 400 <= e.value.code < 500, e.value.code
         finally:
             lh.shutdown()
+
+
+class TestClockSkewSign:
+    """Pin the heartbeat skew estimator's sign convention end-to-end.
+
+    The whole tracing plane assumes ``skew_ms`` is REPLICA-minus-lighthouse
+    (positive when this host's clock runs ahead): ``merge_traces`` subtracts
+    it from span timestamps to land on the lighthouse's clock, and the test
+    clock-offset hook adds injected "runs ahead" offsets to the exported
+    skew. A flipped native estimate would make the merge DOUBLE the skew
+    error on real hosts instead of correcting it — and every other test
+    injects skew via the Python hook, so only this test exercises the
+    native estimator's sign. It answers the real native beat loop from a
+    fake lighthouse (framed-JSON wire protocol) whose fabricated
+    ``server_ms`` runs 5s behind the local clock: a lighthouse 5s BEHIND is
+    this replica 5s AHEAD, so the estimate must come out POSITIVE ~+5000ms.
+    """
+
+    def test_fabricated_server_ms_yields_replica_minus_lighthouse(self):
+        import json
+        import socket
+        import struct
+        import time
+
+        offset_ms = 5000
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        port = srv.getsockname()[1]
+        stop = threading.Event()
+
+        def recv_exact(conn, n):
+            buf = b""
+            while len(buf) < n:
+                chunk = conn.recv(n - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+        def serve_conn(conn):
+            # The RpcClient keeps one cached connection alive across beats.
+            with conn:
+                while not stop.is_set():
+                    hdr = recv_exact(conn, 4)
+                    if hdr is None:
+                        return
+                    (length,) = struct.unpack(">I", hdr)
+                    body = recv_exact(conn, length)
+                    if body is None:
+                        return
+                    req = json.loads(body)
+                    assert req["method"] == "heartbeat"
+                    result = {
+                        "server_ms": int(time.time() * 1000) - offset_ms
+                    }
+                    resp = json.dumps({"ok": True, "result": result}).encode()
+                    conn.sendall(struct.pack(">I", len(resp)) + resp)
+
+        def accept_loop():
+            while not stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                threading.Thread(
+                    target=serve_conn, args=(conn,), daemon=True
+                ).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+        mgr = ManagerServer(
+            replica_id="skew_pin", lighthouse_addr=f"127.0.0.1:{port}",
+            hostname="127.0.0.1", bind="127.0.0.1:0", store_addr="s",
+            world_size=1, heartbeat_interval=0.05,
+        )
+        try:
+            deadline = time.monotonic() + 10.0
+            skew = {}
+            while time.monotonic() < deadline:
+                skew = mgr.clock_skew()
+                if skew.get("samples", 0) >= 1:
+                    break
+                time.sleep(0.02)
+            assert skew.get("samples", 0) >= 1, f"no skew sample: {skew}"
+            # Loopback RTT is ~0ms; generous slack for a loaded CI host.
+            assert skew["skew_ms"] == pytest.approx(offset_ms, abs=1000), skew
+            assert skew["last_skew_ms"] == pytest.approx(
+                offset_ms, abs=1000
+            ), skew
+        finally:
+            mgr.shutdown()
+            stop.set()
+            srv.close()

@@ -470,6 +470,54 @@ def test_manager_and_lighthouse_metrics_serve_prometheus(tmp_path):
     assert native["summary"]["quorum_transitions"] >= 1
 
 
+def test_manager_survives_metrics_port_in_use(tmp_path):
+    """An observability knob must never take down training: with
+    TORCHFT_METRICS_PORT fixed and >1 Manager per host (multiple group
+    ranks, or a restart racing TIME_WAIT), the second bind raises
+    EADDRINUSE — the Manager must warn and run without /metrics, not
+    crash at startup."""
+    import socket
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    taken_port = blocker.getsockname()[1]
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+    )
+    manager = None
+    try:
+        manager = Manager(
+            pg=ProcessGroupHost(timeout=10.0),
+            load_state_dict=lambda sd: None,
+            state_dict=lambda: {"w": np.zeros(4, np.float32)},
+            min_replica_size=1,
+            replica_id="metrics_port_clash",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=10.0,
+            heartbeat_interval=0.05,
+            metrics_port=taken_port,
+        )
+        assert manager.metrics_port is None
+        # the Manager still trains: one managed step end to end
+        manager.start_quorum()
+        manager.allreduce(
+            {"w": np.ones(4, np.float32)}
+        ).get_future().wait(30)
+        assert manager.should_commit()
+    finally:
+        if manager is not None:
+            manager.shutdown(wait=False)
+        lh.shutdown()
+        blocker.close()
+
+
 # --------------------------------------------------------------- acceptance
 def test_fleet_chaos_merge_produces_skew_corrected_timeline(tmp_path):
     """3-replica run with one mid-collective link kill (reroute) and one

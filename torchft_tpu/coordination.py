@@ -426,8 +426,9 @@ class ManagerServer:
         )
 
     def clock_skew(self) -> dict:
-        """Clock-skew estimate vs the lighthouse from heartbeat round-trips
-        (``skew_ms``/``rtt_ms`` from the minimum-RTT beat, plus
+        """Clock-skew estimate vs the lighthouse from heartbeat round-trips,
+        replica-minus-lighthouse: positive when this host's clock runs
+        ahead (``skew_ms``/``rtt_ms`` from the minimum-RTT beat, plus
         ``last_skew_ms``/``last_rtt_ms``/``samples``). ``samples`` is 0
         until the first beat round-trips; the tracing plane stamps
         ``skew_ms`` into every span export so the trace merger can place N

@@ -511,12 +511,23 @@ class Manager:
                     f"ignoring invalid {METRICS_PORT_ENV}={env_metrics!r}"
                 )
         if metrics_port is not None:
-            self._metrics_registry = MetricsRegistry()
-            self._metrics_server = MetricsServer(
-                self._metrics_registry,
-                port=metrics_port,
-                refresh=self._refresh_metrics,
-            )
+            # Never let the observability knob take down training: with a
+            # fixed TORCHFT_METRICS_PORT and >1 Manager per host (multiple
+            # group ranks, or a restart racing TIME_WAIT) the bind raises
+            # EADDRINUSE — run without metrics instead of crashing.
+            try:
+                registry = MetricsRegistry()
+                self._metrics_server = MetricsServer(
+                    registry,
+                    port=metrics_port,
+                    refresh=self._refresh_metrics,
+                )
+                self._metrics_registry = registry
+            except OSError as e:
+                self._logger.warning(
+                    f"metrics server failed to bind port {metrics_port} "
+                    f"({e}); continuing without /metrics"
+                )
 
     # ------------------------------------------------------------- state fns
     def register_state_dict_fn(

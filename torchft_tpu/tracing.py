@@ -15,7 +15,8 @@ stalled bucket 7 of step 412" across a fleet. This module closes that gap:
   ``bench.py --tracing`` gate holds the <1% line).
 - **Skew correction** — each export stamps the replica's clock-skew
   estimate vs the lighthouse (``ManagerServer.clock_skew()``: the beat
-  loop's response ``server_ms`` against the RPC round-trip midpoint, best
+  loop's RPC round-trip midpoint minus the response ``server_ms`` —
+  replica-minus-lighthouse, positive when this clock runs ahead; best
   = minimum-RTT sample). :func:`merge_traces` shifts every replica onto
   the lighthouse's clock, so cross-replica ordering is correct within the
   estimated-skew bound (~RTT/2 on a quiet network).

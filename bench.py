@@ -627,6 +627,79 @@ def tracing(smoke: bool = False) -> None:
     }))
 
 
+def fleet_metrics(smoke: bool = False) -> dict:
+    """Run benchmarks/fleet_bench.py in a subprocess (it stands up native
+    lighthouse/aggregator servers plus hundreds of loopback sockets — own
+    process keeps fd/thread blast radius away from the bench harness) and
+    parse its one-line JSON summary."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "fleet_bench.py",
+    )
+    cmd = [sys.executable, script] + (["--smoke"] if smoke else [])
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True,
+        timeout=600 if smoke else 3000,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet bench failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip().splitlines()[-8:]}"
+        )
+    last = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")][-1]
+    return _json.loads(last)
+
+
+def fleet(smoke: bool = False) -> None:
+    """``python bench.py --fleet [--smoke]``: one JSON line with the flat vs
+    two-level control-plane scaling summary. The gates hold the aggregator
+    tier's two promises: batching + delta-encoding cuts root heartbeat
+    fan-in by a real factor, and quorum convergence through the tier does
+    not degrade with fleet size. Full runs also write BENCH_FLEET.json."""
+    metrics = fleet_metrics(smoke=smoke)
+    required = [
+        "fleet_fanin_ratio_at_max",
+        "fleet_two_level_latency_scaling",
+        "fleet_two_level_convergence_ms_at_max",
+        "fleet_all_converged",
+    ]
+    missing = [k for k in required if metrics.get(k) is None]
+    if missing:
+        raise RuntimeError(f"fleet: missing keys: {missing}")
+    if not metrics["fleet_all_converged"]:
+        raise RuntimeError(
+            "fleet: a quorum round failed to converge — the control plane "
+            "dropped joiners somewhere between replica and root"
+        )
+    # Smoke fleets (40 replicas / 2 aggregators) are far below the batching
+    # tier's design point, so the fan-in win is gated lower there.
+    min_ratio = 2.0 if smoke else 5.0
+    if not metrics["fleet_fanin_ratio_at_max"] >= min_ratio:
+        raise RuntimeError(
+            f"fleet: fan-in reduction {metrics['fleet_fanin_ratio_at_max']:.2f}x "
+            f"< {min_ratio}x — aggregator batching/delta-encoding regressed"
+        )
+    if not smoke and not metrics["fleet_two_level_latency_scaling"] <= 2.0:
+        raise RuntimeError(
+            "fleet: two-level quorum convergence slowed "
+            f"{metrics['fleet_two_level_latency_scaling']:.2f}x from the "
+            "smallest to the largest fleet (budget: 2x)"
+        )
+    print(json.dumps({
+        "metric": "fleet fan-in reduction (flat / two-level)",
+        "value": metrics["fleet_fanin_ratio_at_max"],
+        "unit": "x",
+        "vs_baseline": metrics["fleet_fanin_ratio_at_max"],
+        **metrics,
+    }))
+
+
 def main() -> None:
     # shared fallback policy (ensure_responsive_backend): one probe, one
     # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
@@ -903,6 +976,10 @@ if __name__ == "__main__":
     if "--tracing" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         tracing(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--fleet" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        fleet(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--smoke" in sys.argv[1:]:
         # no always-emit wrapper here: the smoke gate must fail loudly

@@ -1,0 +1,188 @@
+"""Fleet-scale control-plane benchmark: flat vs two-level lighthouse.
+
+Runs the :mod:`torchft_tpu._test.fleet_sim` harness over a grid of fleet
+sizes x topologies and emits one JSON line (last line of stdout) with the
+scaling curve, plus ``BENCH_FLEET.json`` on full runs:
+
+    python benchmarks/fleet_bench.py           # full: 100/500/1000, both
+    python benchmarks/fleet_bench.py --smoke   # tier-1 gate: 40 replicas
+
+The headline numbers the two-level tier must defend (asserted by
+``bench.py --fleet``):
+
+- root heartbeat fan-in bytes per fleet-wide beat interval drops >= 5x at
+  the largest size (>= 2x in smoke, which is too small for the full win);
+- two-level quorum-convergence latency stays flat (within 2x) from the
+  smallest to the largest size, with both sides floored at one root
+  quorum tick — sub-tick latencies are scheduling noise, not a trend.
+
+Everything runs on loopback against the real native servers; fake replicas
+drive the real wire protocol (see fleet_sim's module docstring for the
+phase breakdown). Churn is exercised at every point: a slice of the fleet
+dies mid-run, fresh replicas enroll, and the next quorum round must still
+converge — its latency is reported but not gated here (it is dominated by
+the configured heartbeat expiry, which the chaos-soak test covers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu._test.fleet_sim import FleetConfig, run_fleet  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FULL_SIZES = (100, 500, 1000)
+SMOKE_SIZE = 40
+
+
+def _point_config(n: int, topology: str, smoke: bool) -> FleetConfig:
+    if smoke:
+        return FleetConfig(
+            n_replicas=n,
+            topology=topology,
+            n_aggregators=2 if topology == "two_level" else 0,
+            beat_interval_s=0.3,
+            step_interval_s=3.0,
+            measure_s=3.0,
+            agg_tick_ms=100,
+            heartbeat_timeout_ms=2000,
+            quorum_tick_ms=50,
+            join_timeout_ms=15000,
+            scrape_iters=10,
+            churn_replicas=4,
+        )
+    return FleetConfig(
+        n_replicas=n,
+        topology=topology,
+        # ~1 aggregator per 64 replicas (the operations-guide rule of thumb).
+        n_aggregators=max(1, math.ceil(n / 64)) if topology == "two_level" else 0,
+        beat_interval_s=1.0,
+        step_interval_s=15.0,
+        measure_s=8.0,
+        agg_tick_ms=500,
+        # Generous on a saturated 1-vCPU box: a beat round for 1000 replicas
+        # can stretch well past the interval, and a false death would turn
+        # the fan-in window into a churn measurement.
+        heartbeat_timeout_ms=8000,
+        quorum_tick_ms=100,
+        join_timeout_ms=30000,
+        scrape_iters=25,
+        churn_replicas=max(2, n // 100),
+    )
+
+
+def run_grid(sizes, smoke: bool) -> dict:
+    points = []
+    for n in sizes:
+        for topology in ("flat", "two_level"):
+            cfg = _point_config(n, topology, smoke)
+            print(
+                f"[fleet_bench] {topology} n={n} "
+                f"(aggs={cfg.n_aggregators or 0})...",
+                file=sys.stderr,
+            )
+            points.append(run_fleet(cfg))
+
+    def _pt(n, topology):
+        for p in points:
+            if p["n_replicas"] == n and p["topology"] == topology:
+                return p
+        raise KeyError((n, topology))
+
+    n_max, n_min = max(sizes), min(sizes)
+    flat_max = _pt(n_max, "flat")
+    two_max = _pt(n_max, "two_level")
+    two_min = _pt(n_min, "two_level")
+    fanin_ratio = flat_max["root_fanin_bytes_per_tick"] / max(
+        two_max["root_fanin_bytes_per_tick"], 1.0
+    )
+    # The root evaluates pending quorums on a quorum_tick_ms cadence, so any
+    # convergence under one tick is scheduling noise, not a trend — floor
+    # both sides at one tick before taking the ratio (8ms vs 44ms are both
+    # "instant" next to a 50ms tick; a real regression to hundreds of ms
+    # still blows through the 2x gate).
+    tick_ms = float(two_max.get("quorum_tick_ms", 50))
+    latency_ratio = max(two_max["quorum_convergence_ms"], tick_ms) / max(
+        two_min["quorum_convergence_ms"], tick_ms
+    )
+    summary = {
+        "fleet_sizes": list(sizes),
+        "fleet_fanin_ratio_at_max": fanin_ratio,
+        "fleet_flat_fanin_bytes_per_tick_at_max": flat_max[
+            "root_fanin_bytes_per_tick"
+        ],
+        "fleet_two_level_fanin_bytes_per_tick_at_max": two_max[
+            "root_fanin_bytes_per_tick"
+        ],
+        "fleet_two_level_latency_scaling": latency_ratio,
+        "fleet_two_level_convergence_ms_at_max": two_max[
+            "quorum_convergence_ms"
+        ],
+        "fleet_flat_convergence_ms_at_max": flat_max["quorum_convergence_ms"],
+        "fleet_two_level_delivery_ms_at_max": two_max.get(
+            "quorum_delivery_ms", 0.0
+        ),
+        "fleet_flat_delivery_ms_at_max": flat_max.get(
+            "quorum_delivery_ms", 0.0
+        ),
+        "fleet_all_converged": all(
+            p["quorum_converged"]
+            and (p.get("churn_converged", True) is not False)
+            for p in points
+        ),
+    }
+    return {"points": points, "summary": summary}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument(
+        "--sizes", default="", help="comma-separated fleet sizes override"
+    )
+    parser.add_argument(
+        "--out",
+        default=os.path.join(REPO_ROOT, "BENCH_FLEET.json"),
+        help="scaling-curve output path (full runs only; '-' disables)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.sizes:
+        sizes = tuple(int(s) for s in args.sizes.split(","))
+    else:
+        sizes = (SMOKE_SIZE,) if args.smoke else FULL_SIZES
+
+    result = run_grid(sizes, smoke=args.smoke)
+    if not args.smoke and args.out != "-":
+        with open(args.out, "w") as f:
+            json.dump(
+                {
+                    "bench": "fleet control plane (flat vs two-level)",
+                    "harness": "torchft_tpu/_test/fleet_sim.py",
+                    **result,
+                },
+                f,
+                indent=1,
+                sort_keys=True,
+            )
+            f.write("\n")
+        print(f"[fleet_bench] wrote {args.out}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "fleet fan-in reduction (flat / two-level, largest size)",
+        "value": result["summary"]["fleet_fanin_ratio_at_max"],
+        "unit": "x",
+        "vs_baseline": result["summary"]["fleet_fanin_ratio_at_max"],
+        **result["summary"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,276 @@
+#include "aggregator.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <sstream>
+
+namespace tft {
+
+namespace {
+void log_info(const std::string& id, const std::string& msg) {
+  std::fprintf(stderr, "[aggregator %s] %s\n", id.c_str(), msg.c_str());
+}
+}  // namespace
+
+Aggregator::Aggregator(const std::string& bind, AggregatorOpts opts)
+    : opts_(std::move(opts)), epoch_(epoch_millis_now()) {
+  root_client_ = std::make_unique<RpcClient>(opts_.root_addr,
+                                             Millis(opts_.connect_timeout_ms));
+  server_ = std::make_unique<RpcServer>(
+      bind,
+      [this](const std::string& m, const Json& p, TimePoint d) {
+        return handle(m, p, d);
+      },
+      [this](const std::string& m, const std::string& p) {
+        return handle_http(m, p);
+      });
+  agg_id_ = opts_.agg_id.empty() ? address() : opts_.agg_id;
+  tick_thread_ = std::thread([this] { tick_loop(); });
+}
+
+Aggregator::~Aggregator() { shutdown(); }
+
+std::string Aggregator::address() const {
+  return local_hostname() + ":" + std::to_string(server_->port());
+}
+
+void Aggregator::shutdown() {
+  bool was = running_.exchange(false);
+  if (!was) return;
+  quorum_cv_.notify_all();
+  tick_cv_.notify_all();
+  if (tick_thread_.joinable()) tick_thread_.join();
+  server_->shutdown();
+}
+
+Json Aggregator::handle(const std::string& method, const Json& params,
+                        TimePoint deadline) {
+  if (method == "heartbeat") return rpc_heartbeat(params);
+  if (method == "quorum") return rpc_quorum(params, deadline);
+  if (method == "status") return status_json();
+  throw RpcError("invalid", "unknown aggregator method: " + method);
+}
+
+Json Aggregator::rpc_heartbeat(const Json& params) {
+  std::string rid = params.get("replica_id").as_string();
+  std::lock_guard<std::mutex> lk(mu_);
+  PodReplica& r = pod_[rid];
+  r.last_beat = Clock::now();
+  if (params.contains("telemetry") && !params.get("telemetry").is_null()) {
+    Json t = params.get("telemetry");
+    int64_t step = t.get_or("step", Json(int64_t{-1})).as_int();
+    // Delta cursor: only a step advance marks the payload dirty for the
+    // next upstream tick (the flat protocol re-sends it every beat).
+    if (step != r.telemetry_step) r.telemetry_step = step;
+    r.telemetry = std::move(t);
+  }
+  // Same response shape as the lighthouse beat: the manager's skew
+  // estimator and health mirror work unchanged against an aggregator.
+  Json out = Json::object();
+  out["health"] = r.health.is_null() ? Json::object() : r.health;
+  out["server_ms"] = epoch_millis_now();
+  out["aggregated"] = true;
+  return out;
+}
+
+Json Aggregator::rpc_quorum(const Json& params, TimePoint deadline) {
+  QuorumMember requester = QuorumMember::from_json(params.get("requester"));
+  const std::string& rid = requester.replica_id;
+  log_info(agg_id_, "pod quorum request from " + rid);
+
+  std::unique_lock<std::mutex> lk(mu_);
+  pod_[rid].last_beat = Clock::now();  // implicit beat, like the lighthouse
+  joiners_[rid] = PendingJoiner{requester, deadline};
+  uint64_t waiting_gen = quorum_gen_;
+  // Wake the tick loop so registration isn't delayed a full tick.
+  tick_requested_ = true;
+  tick_cv_.notify_all();
+
+  // Same re-subscribe loop as the lighthouse: wait for a quorum containing
+  // the requester; a quorum published without it re-registers and waits.
+  while (true) {
+    bool got = quorum_cv_.wait_until(lk, deadline, [&] {
+      return !running_.load() || quorum_gen_ > waiting_gen;
+    });
+    if (!running_.load())
+      throw RpcError("unavailable", "aggregator shutting down");
+    if (!got) throw TimeoutError("quorum request timed out (aggregator)");
+    waiting_gen = quorum_gen_;
+    const QuorumSnapshot& q = *latest_quorum_;
+    bool in_quorum = std::any_of(
+        q.participants.begin(), q.participants.end(),
+        [&](const QuorumMember& m) { return m.replica_id == rid; });
+    if (in_quorum) {
+      joiners_.erase(rid);
+      Json out = Json::object();
+      out["quorum"] = q.to_json();
+      return out;
+    }
+    log_info(agg_id_, "replica " + rid + " not in quorum, re-registering");
+    pod_[rid].last_beat = Clock::now();
+    joiners_[rid] = PendingJoiner{requester, deadline};
+    tick_requested_ = true;
+    tick_cv_.notify_all();
+  }
+}
+
+Json Aggregator::build_tick_frame_locked() {
+  auto now = Clock::now();
+  seq_ += 1;
+  Json frame = Json::object();
+  frame["agg_id"] = agg_id_;
+  frame["addr"] = address();
+  frame["epoch"] = epoch_;
+  frame["seq"] = seq_;
+  frame["quorum_gen_seen"] = root_quorum_gen_;
+
+  // Live set: pod replicas with a fresh beat. Prune long-dead entries on
+  // the same 10x horizon the lighthouse uses so pod churn stays bounded.
+  std::set<std::string> live;
+  for (auto it = pod_.begin(); it != pod_.end();) {
+    auto age = now - it->second.last_beat;
+    if (age > Millis(10 * opts_.heartbeat_timeout_ms)) {
+      it = pod_.erase(it);
+      continue;
+    }
+    if (age < Millis(opts_.heartbeat_timeout_ms)) live.insert(it->first);
+    ++it;
+  }
+  if (last_tick_ok_ && live == last_live_sent_) {
+    frame["beats_same"] = true;
+  } else {
+    Json beats = Json::array();
+    for (const auto& rid : live) beats.push_back(rid);
+    frame["beats"] = beats;
+  }
+
+  // Telemetry delta: only steps not yet acked upstream.
+  Json tel = Json::object();
+  for (auto& [rid, r] : pod_) {
+    if (!live.count(rid)) continue;
+    if (r.telemetry_step >= 0 && r.telemetry_step != r.forwarded_step)
+      tel[rid] = r.telemetry;
+  }
+  if (tel.size() > 0) frame["telemetry"] = tel;
+
+  // Pending quorum joiners (drop expired ones so the root's join-timeout
+  // straggler wait isn't held open by an abandoned request).
+  Json joiners = Json::array();
+  for (auto it = joiners_.begin(); it != joiners_.end();) {
+    if (now >= it->second.deadline) {
+      it = joiners_.erase(it);
+      continue;
+    }
+    joiners.push_back(it->second.member.to_json());
+    ++it;
+  }
+  if (joiners.size() > 0) frame["joiners"] = joiners;
+
+  // Stash the computed live set; it becomes the delta cursor only once the
+  // root acks this frame (tick_loop's success path).
+  pending_live_.swap(live);
+  return frame;
+}
+
+void Aggregator::apply_tick_response_locked(const Json& resp) {
+  // Health summaries fan back to the pod beats.
+  if (resp.contains("health") && resp.get("health").is_object()) {
+    for (const auto& [rid, h] : resp.get("health").as_object()) {
+      auto it = pod_.find(rid);
+      if (it != pod_.end()) it->second.health = h;
+    }
+  }
+  if (resp.contains("quorum_gen"))
+    root_quorum_gen_ = resp.get("quorum_gen").as_int();
+  if (resp.contains("quorum") && !resp.get("quorum").is_null()) {
+    latest_quorum_ = QuorumSnapshot::from_json(resp.get("quorum"));
+    quorum_gen_ += 1;
+    // Drop pending joiners this quorum satisfies right now, not when their
+    // blocked handlers next get scheduled — otherwise the next tick frame
+    // re-forwards them and the root re-registers replicas that are no
+    // longer waiting. Handlers wake off latest_quorum_, not this map.
+    for (const auto& m : latest_quorum_->participants) joiners_.erase(m.replica_id);
+    quorum_cv_.notify_all();
+  }
+}
+
+void Aggregator::tick_loop() {
+  while (running_.load()) {
+    Json frame;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      frame = build_tick_frame_locked();
+    }
+    std::string payload = frame.dump();
+    try {
+      Json resp = root_client_->call("agg_tick", frame,
+                                     Millis(opts_.connect_timeout_ms));
+      std::lock_guard<std::mutex> lk(mu_);
+      ticks_ok_ += 1;
+      upstream_bytes_ += payload.size();
+      last_tick_ok_ = true;
+      last_error_.clear();
+      last_live_sent_ = pending_live_;
+      // Ack the telemetry delta cursor for everything we just sent.
+      if (frame.contains("telemetry")) {
+        for (const auto& [rid, t] : frame.get("telemetry").as_object()) {
+          (void)t;
+          auto it = pod_.find(rid);
+          if (it != pod_.end()) it->second.forwarded_step = it->second.telemetry_step;
+        }
+      }
+      apply_tick_response_locked(resp);
+    } catch (const std::exception& e) {
+      std::lock_guard<std::mutex> lk(mu_);
+      ticks_failed_ += 1;
+      last_tick_ok_ = false;  // next frame re-sends the full live set
+      if (last_error_ != e.what()) {
+        last_error_ = e.what();
+        log_info(agg_id_, std::string("upstream tick failed: ") + e.what());
+      }
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    tick_cv_.wait_for(lk, Millis(opts_.tick_ms), [&] {
+      return !running_.load() || tick_requested_;
+    });
+    tick_requested_ = false;
+  }
+}
+
+Json Aggregator::status_json() {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto now = Clock::now();
+  Json j = Json::object();
+  j["agg_id"] = agg_id_;
+  j["root_addr"] = opts_.root_addr;
+  j["epoch"] = epoch_;
+  j["seq"] = seq_;
+  j["pod_size"] = static_cast<int64_t>(pod_.size());
+  int64_t live = 0;
+  for (const auto& [rid, r] : pod_)
+    if (now - r.last_beat < Millis(opts_.heartbeat_timeout_ms)) live += 1;
+  j["pod_live"] = live;
+  j["joiners_pending"] = static_cast<int64_t>(joiners_.size());
+  j["ticks_ok"] = static_cast<int64_t>(ticks_ok_);
+  j["ticks_failed"] = static_cast<int64_t>(ticks_failed_);
+  j["upstream_bytes"] = static_cast<int64_t>(upstream_bytes_);
+  j["last_tick_ok"] = last_tick_ok_;
+  j["last_error"] = last_error_;
+  j["root_quorum_gen"] = root_quorum_gen_;
+  j["rx"] = server_->rx_stats();
+  return j;
+}
+
+std::tuple<std::string, std::string, std::string> Aggregator::handle_http(
+    const std::string& method, const std::string& path) {
+  (void)method;
+  try {
+    if (path == "/status" || path == "/" || path == "/index.html")
+      return {"200 OK", "application/json", status_json().dump()};
+    return {"404 Not Found", "text/plain", "not found"};
+  } catch (const std::exception& e) {
+    return {"500 Internal Server Error", "text/plain", e.what()};
+  }
+}
+
+}  // namespace tft

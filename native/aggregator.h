@@ -1,0 +1,117 @@
+// Lighthouse aggregator: the pod-level tier of the two-level control plane.
+//
+// A flat fleet points every replica-group manager straight at the root
+// lighthouse — N connections, N heartbeat RPCs per beat interval, N blocked
+// quorum waits. That is the wall between "6 replicas on loopback" and a
+// production fleet (Fault Tolerant HSDP on 100k GPUs runs per-step quorum
+// only because heartbeats fan in hierarchically). An Aggregator fronts one
+// pod of replicas and speaks the SAME wire protocol the lighthouse does
+// ("heartbeat", "quorum", /status over HTTP), so a replica points at it via
+// TORCHFT_LIGHTHOUSE_AGGREGATOR with zero Manager API changes. Upstream it
+// collapses the pod into ONE delta-encoded "agg_tick" RPC per tick:
+//
+//   - liveness: the live replica-id set, sent in full only when it CHANGES
+//     ("beats_same" otherwise) — the aggregator vouches for pod freshness;
+//   - telemetry: forwarded only for replicas whose reported step advanced
+//     since the last acked tick (the flat protocol re-sends the full
+//     payload on every beat);
+//   - quorum joins: pending requesters ride the same tick RPC; results fan
+//     back out to the blocked pod RPCs from the tick response.
+//
+// Every frame carries (agg_id, epoch, seq): the root rejects stale deltas
+// from a previous incarnation after an aggregator restart. If the upstream
+// link dies the pod's managers fail over to direct-to-root mode on their
+// own (manager_server.cc) — the aggregator itself just keeps retrying.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <thread>
+
+#include "quorum.h"
+#include "wire.h"
+
+namespace tft {
+
+struct AggregatorOpts {
+  std::string root_addr;          // upstream lighthouse "host:port"
+  std::string agg_id;             // empty -> derived from bind address
+  int64_t tick_ms = 100;          // upstream batching cadence
+  int64_t heartbeat_timeout_ms = 5000;  // pod-liveness horizon (match root)
+  int64_t connect_timeout_ms = 10000;
+};
+
+class Aggregator {
+ public:
+  Aggregator(const std::string& bind, AggregatorOpts opts);
+  ~Aggregator();
+
+  int port() const { return server_->port(); }
+  std::string address() const;
+  const std::string& agg_id() const { return agg_id_; }
+  void shutdown();
+
+  // Local pod + upstream view (also served at GET /status): pod size, live
+  // set, pending joiners, upstream tick counters, last error.
+  Json status_json();
+
+ private:
+  Json handle(const std::string& method, const Json& params, TimePoint deadline);
+  std::tuple<std::string, std::string, std::string> handle_http(
+      const std::string& method, const std::string& path);
+
+  Json rpc_heartbeat(const Json& params);
+  Json rpc_quorum(const Json& params, TimePoint deadline);
+
+  void tick_loop();
+  // Build the delta frame under mu_ (returns null when nothing to send and
+  // the live set is unchanged — a keepalive frame is still sent so the
+  // root's aggregator registry stays fresh).
+  Json build_tick_frame_locked();
+  void apply_tick_response_locked(const Json& resp);
+
+  struct PodReplica {
+    TimePoint last_beat{};
+    Json telemetry;               // latest payload from the pod beat
+    int64_t telemetry_step = -1;  // step of `telemetry`
+    int64_t forwarded_step = -1;  // last step acked upstream (delta cursor)
+    Json health;                  // cached root health summary (fanned back)
+  };
+
+  struct PendingJoiner {
+    QuorumMember member;
+    TimePoint deadline;  // drop expired joiners so the root stops waiting
+  };
+
+  AggregatorOpts opts_;
+  std::string agg_id_;
+  int64_t epoch_ = 0;  // epoch_millis at construction; restarts bump it
+  int64_t seq_ = 0;    // per-epoch tick sequence
+
+  std::mutex mu_;
+  std::condition_variable quorum_cv_;  // pod quorum fan-out
+  std::condition_variable tick_cv_;    // wake the tick loop early on joins
+  bool tick_requested_ = false;
+  std::map<std::string, PodReplica> pod_;
+  std::map<std::string, PendingJoiner> joiners_;
+  std::set<std::string> last_live_sent_;  // delta cursor for the live set
+  std::set<std::string> pending_live_;    // live set of the in-flight frame
+  bool last_tick_ok_ = false;
+  std::string last_error_;
+  uint64_t ticks_ok_ = 0;
+  uint64_t ticks_failed_ = 0;
+  uint64_t upstream_bytes_ = 0;  // serialized agg_tick param bytes sent
+  int64_t root_quorum_gen_ = 0;  // root's broadcast generation we've seen
+  uint64_t quorum_gen_ = 0;      // local fan-out generation
+  std::optional<QuorumSnapshot> latest_quorum_;
+
+  std::atomic<bool> running_{true};
+  std::unique_ptr<RpcServer> server_;
+  std::unique_ptr<RpcClient> root_client_;
+  std::thread tick_thread_;
+};
+
+}  // namespace tft

@@ -10,6 +10,7 @@
 #include <cstring>
 #include <string>
 
+#include "aggregator.h"
 #include "healthwatch.h"
 #include "history.h"
 #include "kvstore.h"
@@ -95,6 +96,8 @@ int tft_lighthouse_new_v2(const char* opts_json, void** out, char** err) {
     opts.heartbeat_timeout_ms =
         j.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
     opts.history_path = j.get_or("history_path", Json("")).as_string();
+    opts.metrics_per_replica_limit =
+        j.get_or("metrics_per_replica_limit", Json(int64_t{64})).as_int();
     HealthOpts health =
         HealthOpts::from_json(j.get_or("health", Json::object()));
     *out = new Lighthouse(bind, opts, health);
@@ -110,6 +113,39 @@ void tft_lighthouse_shutdown(void* h) {
   static_cast<Lighthouse*>(h)->shutdown();
 }
 void tft_lighthouse_free(void* h) { delete static_cast<Lighthouse*>(h); }
+
+// ---------------------------------------------------------------- aggregator
+// Pod-level lighthouse aggregator (aggregator.h). opts_json: {"bind": ...,
+// "root_addr": ..., "agg_id": ...?, "tick_ms": N, "heartbeat_timeout_ms": N,
+// "connect_timeout_ms": N}.
+int tft_aggregator_new(const char* opts_json, void** out, char** err) {
+  TFT_TRY({
+    Json j = Json::parse(opts_json);
+    AggregatorOpts opts;
+    std::string bind = j.get_or("bind", Json("0.0.0.0:0")).as_string();
+    opts.root_addr = j.get("root_addr").as_string();
+    opts.agg_id = j.get_or("agg_id", Json("")).as_string();
+    opts.tick_ms = j.get_or("tick_ms", Json(int64_t{100})).as_int();
+    opts.heartbeat_timeout_ms =
+        j.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
+    opts.connect_timeout_ms =
+        j.get_or("connect_timeout_ms", Json(int64_t{10000})).as_int();
+    *out = new Aggregator(bind, opts);
+    return TFT_OK;
+  })
+}
+
+char* tft_aggregator_address(void* h) {
+  return dup_str(static_cast<Aggregator*>(h)->address());
+}
+int tft_aggregator_port(void* h) { return static_cast<Aggregator*>(h)->port(); }
+char* tft_aggregator_status(void* h) {
+  return dup_str(static_cast<Aggregator*>(h)->status_json().dump());
+}
+void tft_aggregator_shutdown(void* h) {
+  static_cast<Aggregator*>(h)->shutdown();
+}
+void tft_aggregator_free(void* h) { delete static_cast<Aggregator*>(h); }
 
 // ------------------------------------------------------------------- manager
 int tft_manager_new(const char* opts_json, void** out, char** err) {
@@ -127,9 +163,14 @@ int tft_manager_new(const char* opts_json, void** out, char** err) {
     opts.connect_timeout_ms =
         j.get_or("connect_timeout_ms", Json(int64_t{10000})).as_int();
     opts.quorum_retries = j.get_or("quorum_retries", Json(int64_t{0})).as_int();
+    opts.aggregator_addr = j.get_or("aggregator_addr", Json("")).as_string();
     *out = new ManagerServer(opts);
     return TFT_OK;
   })
+}
+
+char* tft_manager_control_status(void* h) {
+  return dup_str(static_cast<ManagerServer*>(h)->control_status_json());
 }
 
 int tft_manager_publish_telemetry(void* h, const char* telemetry_json,

@@ -103,6 +103,16 @@ void Lighthouse::quorum_tick_locked() {
       ++it;
     }
   }
+  // Aggregators prune on the same horizon: a dead aggregator's pod has long
+  // since failed over to direct mode, and its registry entry must not keep
+  // being named as a replacement.
+  for (auto it = aggregators_.begin(); it != aggregators_.end();) {
+    if (now - it->second.last_tick > Millis(10 * opts_.heartbeat_timeout_ms)) {
+      it = aggregators_.erase(it);
+    } else {
+      ++it;
+    }
+  }
   // Health ledger tick: probation -> readmission transitions (time-based)
   // and pruning on the same 10x horizon as the heartbeat map above.
   apply_health_events_locked(
@@ -181,6 +191,7 @@ Json Lighthouse::handle(const std::string& method, const Json& params,
                         TimePoint deadline) {
   if (method == "quorum") return rpc_quorum(params, deadline);
   if (method == "heartbeat") return rpc_heartbeat(params);
+  if (method == "agg_tick") return rpc_agg_tick(params);
   if (method == "status") return status_json();
   if (method == "health") return health_json();
   throw RpcError("invalid", "unknown lighthouse method: " + method);
@@ -234,11 +245,45 @@ Json Lighthouse::rpc_quorum(const Json& params, TimePoint deadline) {
   }
 }
 
+void Lighthouse::apply_beat_locked(const std::string& replica_id,
+                                   const Json* telemetry, TimePoint now) {
+  state_.heartbeats[replica_id] = now;
+  apply_health_events_locked(ledger_.on_heartbeat(replica_id, telemetry, now));
+  // History: sample one telemetry snapshot per (replica, step) — beats
+  // re-sending the same payload cost nothing, matching the ledger's dedup.
+  if (history_.enabled() && telemetry != nullptr) {
+    int64_t step = telemetry->get_or("step", Json(int64_t{-1})).as_int();
+    auto it = history_telemetry_step_.find(replica_id);
+    if (it == history_telemetry_step_.end() || it->second != step) {
+      history_telemetry_step_[replica_id] = step;
+      Json e = Json::object();
+      e["kind"] = std::string("telemetry");
+      e["replica_id"] = replica_id;
+      e["step"] = step;
+      e["telemetry"] = *telemetry;
+      history_.append(e);
+    }
+  }
+}
+
+std::string Lighthouse::pick_aggregator_locked(TimePoint now) const {
+  std::string addr;
+  TimePoint best{};
+  for (const auto& [aid, info] : aggregators_) {
+    if (info.addr.empty()) continue;
+    if (now - info.last_tick >= Millis(opts_.heartbeat_timeout_ms)) continue;
+    if (addr.empty() || info.last_tick > best) {
+      addr = info.addr;
+      best = info.last_tick;
+    }
+  }
+  return addr;
+}
+
 Json Lighthouse::rpc_heartbeat(const Json& params) {
   std::string replica_id = params.get("replica_id").as_string();
   std::lock_guard<std::mutex> lk(mu_);
   auto now = Clock::now();
-  state_.heartbeats[replica_id] = now;
   // Optional telemetry payload rides the existing beat; the ledger dedups
   // by step so re-sent payloads cost nothing.
   const Json* telemetry = nullptr;
@@ -247,22 +292,7 @@ Json Lighthouse::rpc_heartbeat(const Json& params) {
     t = params.get("telemetry");
     telemetry = &t;
   }
-  apply_health_events_locked(ledger_.on_heartbeat(replica_id, telemetry, now));
-  // History: sample one telemetry snapshot per (replica, step) — beats
-  // re-sending the same payload cost nothing, matching the ledger's dedup.
-  if (history_.enabled() && telemetry != nullptr) {
-    int64_t step = t.get_or("step", Json(int64_t{-1})).as_int();
-    auto it = history_telemetry_step_.find(replica_id);
-    if (it == history_telemetry_step_.end() || it->second != step) {
-      history_telemetry_step_[replica_id] = step;
-      Json e = Json::object();
-      e["kind"] = std::string("telemetry");
-      e["replica_id"] = replica_id;
-      e["step"] = step;
-      e["telemetry"] = t;
-      history_.append(e);
-    }
-  }
+  apply_beat_locked(replica_id, telemetry, now);
   // The response carries this replica's health summary back to its Manager
   // (surfaced in Manager.timings() and the torchft_health event stream).
   // server_ms lets the beat loop estimate clock skew vs this lighthouse
@@ -270,6 +300,112 @@ Json Lighthouse::rpc_heartbeat(const Json& params) {
   Json out = Json::object();
   out["health"] = ledger_.replica_json(replica_id);
   out["server_ms"] = epoch_millis_now();
+  // A manager beating directly while configured for an aggregator asks for
+  // a replacement; name the freshest live aggregator so the pod re-forms.
+  // Flat fleets never send want_aggregator, so their response is unchanged.
+  if (params.get_or("want_aggregator", Json(false)).as_bool()) {
+    std::string agg = pick_aggregator_locked(now);
+    if (!agg.empty()) out["aggregator"] = agg;
+  }
+  return out;
+}
+
+Json Lighthouse::rpc_agg_tick(const Json& params) {
+  std::string agg_id = params.get("agg_id").as_string();
+  int64_t epoch = params.get("epoch").as_int();
+  int64_t seq = params.get("seq").as_int();
+  std::lock_guard<std::mutex> lk(mu_);
+  auto now = Clock::now();
+  AggregatorInfo& info = aggregators_[agg_id];
+  // Stale-delta rejection: frames from a previous incarnation (lower epoch)
+  // or replayed/reordered frames (non-increasing seq) must not regress the
+  // registry — a restarted aggregator's stray in-flight tick could otherwise
+  // resurrect a superseded live set.
+  if (epoch < info.epoch || (epoch == info.epoch && seq <= info.last_seq))
+    throw RpcError("invalid", "stale aggregator delta from " + agg_id +
+                                  " (epoch=" + std::to_string(epoch) +
+                                  " seq=" + std::to_string(seq) + ")");
+  if (epoch > info.epoch) {
+    // New incarnation: forget the old live set so beats_same can't lie.
+    info.epoch = epoch;
+    info.live.clear();
+    info.has_live = false;
+    log_info("aggregator " + agg_id + " epoch " + std::to_string(epoch));
+  }
+  info.last_seq = seq;
+  info.addr = params.get_or("addr", Json(std::string())).as_string();
+  info.last_tick = now;
+  info.ticks += 1;
+
+  if (params.get_or("beats_same", Json(false)).as_bool()) {
+    // Reuse the stored live set. If we've never seen one this incarnation
+    // (e.g. this lighthouse restarted), fail the tick: the aggregator treats
+    // any error as a failed tick and re-sends the full set next frame.
+    if (!info.has_live)
+      throw RpcError("invalid",
+                     "beats_same from " + agg_id + " with no known live set");
+  } else if (params.contains("beats")) {
+    std::set<std::string> live;
+    for (const auto& b : params.get("beats").as_array())
+      live.insert(b.as_string());
+    info.live = std::move(live);
+    info.has_live = true;
+  }
+  // The aggregator vouches for pod freshness: every live replica beats.
+  for (const auto& rid : info.live) apply_beat_locked(rid, nullptr, now);
+  // Telemetry deltas (only replicas whose step advanced since last ack).
+  if (params.contains("telemetry")) {
+    for (const auto& [rid, t] : params.get("telemetry").as_object())
+      apply_beat_locked(rid, &t, now);
+  }
+  // Quorum joiners ride the tick. Re-registering an already-joined replica
+  // must preserve its original join time — the join_timeout straggler wait
+  // is measured from first join, and the aggregator re-sends pending
+  // joiners every tick.
+  //
+  // Generation gate: a frame built before this aggregator saw the latest
+  // quorum (quorum_gen_seen behind ours) may still carry joiners that the
+  // in-flight quorum already satisfied — registering them would pollute the
+  // next round's participant set with replicas that are no longer waiting
+  // (and can trip a premature fast quorum). Skip them; the response below
+  // syncs the aggregator's generation, it drops satisfied joiners, and any
+  // genuinely-still-pending joiner is re-sent next tick (one tick of added
+  // join latency only in the publish race window).
+  int64_t gen_seen = params.get_or("quorum_gen_seen", Json(int64_t{0})).as_int();
+  bool joiners_current = gen_seen >= static_cast<int64_t>(quorum_gen_);
+  bool had_joiners = false;
+  if (joiners_current && params.contains("joiners")) {
+    for (const auto& jm : params.get("joiners").as_array()) {
+      QuorumMember m = QuorumMember::from_json(jm);
+      auto it = state_.participants.find(m.replica_id);
+      if (it != state_.participants.end()) {
+        it->second.member = m;
+      } else {
+        state_.participants[m.replica_id] = MemberDetails{now, m};
+      }
+      apply_beat_locked(m.replica_id, nullptr, now);
+      had_joiners = true;
+    }
+  }
+  // Proactive tick (mirrors rpc_quorum) so a ready quorum resolves within
+  // one aggregator tick instead of waiting for the timer.
+  if (had_joiners) quorum_tick_locked();
+
+  Json out = Json::object();
+  out["server_ms"] = epoch_millis_now();
+  out["quorum_gen"] = static_cast<int64_t>(quorum_gen_);
+  if (latest_quorum_ && static_cast<int64_t>(quorum_gen_) > gen_seen)
+    out["quorum"] = latest_quorum_->to_json();
+  // Health fan-back is bounded: only replicas with telemetry in THIS frame
+  // get a summary (their managers see it on the next pod beat).
+  if (params.contains("telemetry")) {
+    Json h = Json::object();
+    for (const auto& [rid, t] : params.get("telemetry").as_object()) {
+      (void)t;
+      h[rid] = ledger_.replica_json(rid);
+    }
+    out["health"] = h;
+  }
   return out;
 }
 
@@ -310,6 +446,22 @@ Json Lighthouse::status_json() {
   Json ex = Json::array();
   for (const auto& rid : state_.excluded) ex.push_back(rid);
   j["excluded"] = ex;
+  Json aggs = Json::object();
+  for (const auto& [aid, info] : aggregators_) {
+    Json a = Json::object();
+    a["addr"] = info.addr;
+    a["epoch"] = info.epoch;
+    a["seq"] = info.last_seq;
+    a["age_ms"] = static_cast<int64_t>(
+        std::chrono::duration_cast<Millis>(now - info.last_tick).count());
+    a["live"] = static_cast<int64_t>(info.live.size());
+    a["ticks"] = static_cast<int64_t>(info.ticks);
+    aggs[aid] = a;
+  }
+  j["aggregators"] = aggs;
+  // Per-method receive accounting — the fleet bench reads this to compare
+  // heartbeat fan-in bytes between flat and 2-level topologies.
+  j["rx"] = server_->rx_stats();
   return j;
 }
 
@@ -341,51 +493,99 @@ std::string Lighthouse::metrics_text() {
      << "torchft_lighthouse_history_events_total "
      << history_.events_written() << "\n";
 
-  os << "# HELP torchft_lighthouse_heartbeat_age_ms Milliseconds since the"
-        " replica's last heartbeat\n"
-     << "# TYPE torchft_lighthouse_heartbeat_age_ms gauge\n";
-  for (const auto& [rid, last] : state_.heartbeats) {
-    auto age = std::chrono::duration_cast<Millis>(now - last).count();
-    os << "torchft_lighthouse_heartbeat_age_ms{replica=\"" << prom_label(rid)
-       << "\"} " << age << "\n";
+  gauge("torchft_lighthouse_aggregators",
+        "Live lighthouse aggregators in the registry",
+        static_cast<double>(aggregators_.size()));
+  {
+    Json rx = server_->rx_stats();
+    os << "# HELP torchft_lighthouse_rx_bytes_total Request frame bytes"
+          " received, by RPC method\n"
+       << "# TYPE torchft_lighthouse_rx_bytes_total counter\n";
+    for (const auto& [method, s] : rx.as_object()) {
+      os << "torchft_lighthouse_rx_bytes_total{method=\""
+         << prom_label(method) << "\"} " << s.get("bytes").as_int() << "\n";
+    }
   }
+
+  // Per-replica families are capped at metrics_per_replica_limit series
+  // (lexicographic, so the emitted set is stable across scrapes); the tail
+  // collapses into aggregate min/median/max so fleet-scale cardinality
+  // stays bounded. <= limit replicas emits exactly the pre-cap format.
+  const size_t limit = static_cast<size_t>(
+      std::max<int64_t>(opts_.metrics_per_replica_limit, 0));
+  auto emit_family = [&os, limit](const char* name, const char* help,
+                                  const char* type,
+                                  const std::vector<std::pair<std::string, double>>&
+                                      vals) {
+    os << "# HELP " << name << " " << help << "\n# TYPE " << name << " "
+       << type << "\n";
+    std::vector<double> tail;
+    size_t emitted = 0;
+    for (const auto& [rid, v] : vals) {
+      if (emitted < limit) {
+        os << name << "{replica=\"" << prom_label(rid) << "\"} " << v << "\n";
+        emitted += 1;
+      } else {
+        tail.push_back(v);
+      }
+    }
+    if (!tail.empty()) {
+      std::sort(tail.begin(), tail.end());
+      double med = tail.size() % 2 == 1
+                       ? tail[tail.size() / 2]
+                       : (tail[tail.size() / 2 - 1] + tail[tail.size() / 2]) / 2.0;
+      os << name << "{replica=\"_tail\",stat=\"min\"} " << tail.front() << "\n"
+         << name << "{replica=\"_tail\",stat=\"median\"} " << med << "\n"
+         << name << "{replica=\"_tail\",stat=\"max\"} " << tail.back() << "\n";
+    }
+  };
+
+  std::vector<std::pair<std::string, double>> ages;
+  ages.reserve(state_.heartbeats.size());
+  for (const auto& [rid, last] : state_.heartbeats) {
+    ages.emplace_back(
+        rid, static_cast<double>(
+                 std::chrono::duration_cast<Millis>(now - last).count()));
+  }
+  emit_family("torchft_lighthouse_heartbeat_age_ms",
+              "Milliseconds since the replica's last heartbeat", "gauge",
+              ages);
+  gauge("torchft_lighthouse_heartbeat_replicas",
+        "Replicas currently tracked in the heartbeat map",
+        static_cast<double>(state_.heartbeats.size()));
+  gauge("torchft_lighthouse_metrics_replica_limit",
+        "Per-replica series cap (TORCHFT_METRICS_PER_REPLICA_LIMIT)",
+        static_cast<double>(limit));
 
   // Per-replica health ledger view. state codes match HealthState:
   // 0=ok 1=warn 2=ejected 3=probation.
   Json h = ledger_.to_json(now);
   const auto& reps = h.get("replicas").as_object();
-  os << "# HELP torchft_lighthouse_replica_state Health state code"
-        " (0=ok 1=warn 2=ejected 3=probation)\n"
-     << "# TYPE torchft_lighthouse_replica_state gauge\n";
+  std::vector<std::pair<std::string, double>> states, scores, ejections,
+      readmissions;
   for (const auto& [rid, r] : reps) {
     std::string state = r.get("state").as_string();
     int code = state == "warn" ? 1 : state == "ejected" ? 2
                : state == "probation" ? 3 : 0;
-    os << "torchft_lighthouse_replica_state{replica=\"" << prom_label(rid)
-       << "\"} " << code << "\n";
+    states.emplace_back(rid, static_cast<double>(code));
+    scores.emplace_back(rid, r.get("score").as_double());
+    ejections.emplace_back(rid,
+                           static_cast<double>(r.get("ejections").as_int()));
+    readmissions.emplace_back(
+        rid, static_cast<double>(r.get("readmissions").as_int()));
   }
-  os << "# HELP torchft_lighthouse_straggler_score Modified-z straggler"
-        " score (quorum-relative compute time)\n"
-     << "# TYPE torchft_lighthouse_straggler_score gauge\n";
-  for (const auto& [rid, r] : reps) {
-    os << "torchft_lighthouse_straggler_score{replica=\"" << prom_label(rid)
-       << "\"} " << r.get("score").as_double() << "\n";
-  }
-  os << "# HELP torchft_lighthouse_replica_ejections_total Times the"
-        " replica was ejected by the health policy\n"
-     << "# TYPE torchft_lighthouse_replica_ejections_total counter\n";
-  for (const auto& [rid, r] : reps) {
-    os << "torchft_lighthouse_replica_ejections_total{replica=\""
-       << prom_label(rid) << "\"} " << r.get("ejections").as_int() << "\n";
-  }
-  os << "# HELP torchft_lighthouse_replica_readmissions_total Times the"
-        " replica was readmitted after probation\n"
-     << "# TYPE torchft_lighthouse_replica_readmissions_total counter\n";
-  for (const auto& [rid, r] : reps) {
-    os << "torchft_lighthouse_replica_readmissions_total{replica=\""
-       << prom_label(rid) << "\"} " << r.get("readmissions").as_int()
-       << "\n";
-  }
+  emit_family("torchft_lighthouse_replica_state",
+              "Health state code (0=ok 1=warn 2=ejected 3=probation)",
+              "gauge", states);
+  emit_family("torchft_lighthouse_straggler_score",
+              "Modified-z straggler score (quorum-relative compute time)",
+              "gauge", scores);
+  emit_family("torchft_lighthouse_replica_ejections_total",
+              "Times the replica was ejected by the health policy", "counter",
+              ejections);
+  emit_family("torchft_lighthouse_replica_readmissions_total",
+              "Times the replica was readmitted after probation", "counter",
+              readmissions);
   return os.str();
 }
 

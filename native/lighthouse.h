@@ -35,6 +35,11 @@ class Lighthouse {
 
   Json rpc_quorum(const Json& params, TimePoint deadline);
   Json rpc_heartbeat(const Json& params);
+  // Batched pod delta from a lighthouse aggregator (aggregator.h): applies
+  // the pod's live set + telemetry deltas + quorum joiners in one RPC and
+  // returns quorum/health fan-back. Rejects stale (epoch, seq) frames from
+  // a previous aggregator incarnation.
+  Json rpc_agg_tick(const Json& params);
   Json status_json();
   Json health_json();
   std::string status_html();
@@ -42,6 +47,12 @@ class Lighthouse {
   std::string metrics_text();
   // Must hold mu_. Log + sync ledger exclusions into the quorum state.
   void apply_health_events_locked(const std::vector<Json>& events);
+  // Must hold mu_. Shared beat path for direct heartbeats and aggregator
+  // batches: heartbeat timestamp + health ledger + history telemetry dedup.
+  void apply_beat_locked(const std::string& replica_id, const Json* telemetry,
+                         TimePoint now);
+  // Must hold mu_. Address of a live aggregator (freshest tick), or "".
+  std::string pick_aggregator_locked(TimePoint now) const;
 
   void tick_loop();
   // Must hold mu_. Runs one quorum computation; publishes on success.
@@ -56,6 +67,19 @@ class Lighthouse {
   // Per-replica last telemetry step recorded to history (dedup: a re-sent
   // beat payload for the same step writes nothing). Guarded by mu_.
   std::map<std::string, int64_t> history_telemetry_step_;
+  // Aggregator registry: one entry per aggregator incarnation, used for
+  // stale-delta rejection, beats_same expansion, and replacement naming
+  // when a direct-mode manager asks for an aggregator. Guarded by mu_.
+  struct AggregatorInfo {
+    std::string addr;
+    int64_t epoch = 0;
+    int64_t last_seq = 0;
+    TimePoint last_tick{};
+    std::set<std::string> live;  // last full live set received
+    bool has_live = false;       // full set seen this incarnation
+    uint64_t ticks = 0;
+  };
+  std::map<std::string, AggregatorInfo> aggregators_;
   // Broadcast channel: bump generation + store latest quorum.
   uint64_t quorum_gen_ = 0;
   std::optional<QuorumSnapshot> latest_quorum_;

@@ -18,6 +18,7 @@ ManagerServer::ManagerServer(ManagerOpts opts) : opts_(std::move(opts)) {
       opts_.lighthouse_addr, Millis(opts_.connect_timeout_ms));
   quorum_client_ = std::make_unique<RpcClient>(
       opts_.lighthouse_addr, Millis(opts_.connect_timeout_ms));
+  if (!opts_.aggregator_addr.empty()) adopt_aggregator(opts_.aggregator_addr);
   server_ = std::make_unique<RpcServer>(
       opts_.bind, [this](const std::string& m, const Json& p, TimePoint d) {
         return handle(m, p, d);
@@ -70,6 +71,40 @@ std::string ManagerServer::clock_skew_json() const {
   return j.dump();
 }
 
+std::shared_ptr<RpcClient> ManagerServer::agg_client(bool for_quorum) const {
+  std::lock_guard<std::mutex> lk(agg_mu_);
+  return for_quorum ? agg_quorum_client_ : agg_heartbeat_client_;
+}
+
+void ManagerServer::adopt_aggregator(const std::string& addr) {
+  std::lock_guard<std::mutex> lk(agg_mu_);
+  if (addr == agg_addr_ && agg_heartbeat_client_ && !agg_down_.load()) return;
+  agg_addr_ = addr;
+  // Separate beat/quorum clients for the same reason as the root pair.
+  // Short connect timeout: connect_with_retry keeps retrying a refused
+  // connection until its deadline, so a DEAD aggregator would otherwise
+  // burn the full connect budget (default 10s) before failing over —
+  // starving beats past the lighthouse expiry and eating the quorum
+  // round's deadline. 1s bounds the failover cost; a live aggregator
+  // connects instantly and blocking quorum waits are unaffected.
+  Millis agg_connect(std::min<int64_t>(opts_.connect_timeout_ms, 1000));
+  agg_heartbeat_client_ = std::make_shared<RpcClient>(addr, agg_connect);
+  agg_quorum_client_ = std::make_shared<RpcClient>(addr, agg_connect);
+  agg_down_.store(false);
+}
+
+std::string ManagerServer::control_status_json() const {
+  std::lock_guard<std::mutex> lk(agg_mu_);
+  bool configured = !agg_addr_.empty();
+  bool via_agg = configured && !agg_down_.load();
+  Json j = Json::object();
+  j["aggregator_addr"] = agg_addr_;
+  j["via_aggregator"] = via_agg;
+  j["direct_mode"] = !via_agg;
+  j["failovers"] = agg_failovers_.load();
+  return j.dump();
+}
+
 void ManagerServer::heartbeat_loop() {
   while (running_.load()) {
     try {
@@ -84,31 +119,68 @@ void ManagerServer::heartbeat_loop() {
       // the lighthouse's 5s expiry and get a LIVE replica evicted. 2s keeps
       // several retries inside the expiry window.
       int64_t beat_ms = std::min<int64_t>(opts_.connect_timeout_ms, 2000);
-      int64_t t0 = epoch_millis_now();
-      Json resp = heartbeat_client_->call("heartbeat", params, Millis(beat_ms));
-      int64_t t1 = epoch_millis_now();
-      if (resp.contains("health")) {
-        std::lock_guard<std::mutex> lk(telemetry_mu_);
-        last_health_ = resp.get("health").dump();
+      bool sent = false;
+      std::shared_ptr<RpcClient> agg =
+          agg_down_.load() ? nullptr : agg_client(false);
+      if (agg) {
+        try {
+          Json resp = agg->call("heartbeat", params, Millis(beat_ms));
+          if (resp.contains("health")) {
+            std::lock_guard<std::mutex> lk(telemetry_mu_);
+            last_health_ = resp.get("health").dump();
+          }
+          // No skew update: the aggregator answers with ITS clock, not the
+          // root lighthouse's — mixing the two would corrupt the estimate.
+          sent = true;
+        } catch (const std::exception& e) {
+          agg_down_.store(true);
+          agg_failovers_.fetch_add(1);
+          log_info(opts_.replica_id,
+                   std::string("aggregator beat failed, failing over to "
+                               "direct lighthouse: ") +
+                       e.what());
+        }
       }
-      // Skew vs the lighthouse: the round-trip midpoint against server_ms.
-      // Sign convention is replica-minus-lighthouse (positive when THIS
-      // clock runs ahead) — the trace merger subtracts skew_ms to move
-      // replica timestamps onto the lighthouse's clock. Keep the
-      // minimum-RTT sample's estimate — its midpoint assumption
-      // (symmetric path) has the least queueing error (NTP's rule).
-      if (resp.contains("server_ms")) {
-        double server_ms =
-            static_cast<double>(resp.get("server_ms").as_int());
-        double rtt = static_cast<double>(t1 - t0);
-        double skew = (static_cast<double>(t0 + t1) / 2.0) - server_ms;
-        std::lock_guard<std::mutex> lk(telemetry_mu_);
-        skew_samples_ += 1;
-        last_rtt_ms_ = rtt;
-        last_skew_ms_ = skew;
-        if (skew_samples_ == 1 || rtt <= best_rtt_ms_) {
-          best_rtt_ms_ = rtt;
-          best_skew_ms_ = skew;
+      if (!sent) {
+        // Direct-to-root beat. While configured for an aggregator, ask the
+        // root to name a (replacement) aggregator so the pod can re-form;
+        // a flat fleet sends exactly the pre-aggregator frame.
+        {
+          std::lock_guard<std::mutex> lk(agg_mu_);
+          if (!agg_addr_.empty()) params["want_aggregator"] = true;
+        }
+        int64_t t0 = epoch_millis_now();
+        Json resp = heartbeat_client_->call("heartbeat", params, Millis(beat_ms));
+        int64_t t1 = epoch_millis_now();
+        if (resp.contains("health")) {
+          std::lock_guard<std::mutex> lk(telemetry_mu_);
+          last_health_ = resp.get("health").dump();
+        }
+        // Skew vs the lighthouse: the round-trip midpoint against server_ms.
+        // Sign convention is replica-minus-lighthouse (positive when THIS
+        // clock runs ahead) — the trace merger subtracts skew_ms to move
+        // replica timestamps onto the lighthouse's clock. Keep the
+        // minimum-RTT sample's estimate — its midpoint assumption
+        // (symmetric path) has the least queueing error (NTP's rule).
+        if (resp.contains("server_ms")) {
+          double server_ms =
+              static_cast<double>(resp.get("server_ms").as_int());
+          double rtt = static_cast<double>(t1 - t0);
+          double skew = (static_cast<double>(t0 + t1) / 2.0) - server_ms;
+          std::lock_guard<std::mutex> lk(telemetry_mu_);
+          skew_samples_ += 1;
+          last_rtt_ms_ = rtt;
+          last_skew_ms_ = skew;
+          if (skew_samples_ == 1 || rtt <= best_rtt_ms_) {
+            best_rtt_ms_ = rtt;
+            best_skew_ms_ = skew;
+          }
+        }
+        if (resp.contains("aggregator")) {
+          std::string replacement = resp.get("aggregator").as_string();
+          log_info(opts_.replica_id,
+                   "root named aggregator " + replacement + ", re-pointing");
+          adopt_aggregator(replacement);
         }
       }
     } catch (const std::exception& e) {
@@ -149,7 +221,32 @@ void ManagerServer::run_lighthouse_quorum(QuorumMember member, Millis timeout) {
   int64_t retries = std::max<int64_t>(opts_.quorum_retries, 0);
   for (int64_t attempt = 0; attempt <= retries; ++attempt) {
     try {
-      Json resp = quorum_client_->call("quorum", params, timeout);
+      Json resp;
+      bool got_resp = false;
+      TimePoint attempt_deadline = Clock::now() + timeout;
+      std::shared_ptr<RpcClient> agg =
+          agg_down_.load() ? nullptr : agg_client(true);
+      if (agg) {
+        try {
+          resp = agg->call("quorum", params, timeout);
+          got_resp = true;
+        } catch (const std::exception& e) {
+          // Aggregator died mid-round: fail over to the root with the
+          // budget that's left so this quorum round is not lost. A dead
+          // aggregator fails fast (connection refused / broken pipe),
+          // leaving nearly the full budget.
+          agg_down_.store(true);
+          agg_failovers_.fetch_add(1);
+          log_info(opts_.replica_id,
+                   std::string("aggregator quorum failed, failing over to "
+                               "direct lighthouse: ") +
+                       e.what());
+        }
+      }
+      if (!got_resp) {
+        Millis remaining(std::max<int64_t>(ms_until(attempt_deadline), 1));
+        resp = quorum_client_->call("quorum", params, remaining);
+      }
       QuorumSnapshot q = QuorumSnapshot::from_json(resp.get("quorum"));
       std::lock_guard<std::mutex> lk(mu_);
       latest_quorum_ = q;

@@ -22,6 +22,11 @@ namespace tft {
 struct ManagerOpts {
   std::string replica_id;
   std::string lighthouse_addr;
+  // Optional pod aggregator (aggregator.h) to prefer for heartbeats and
+  // quorum; empty = flat fleet, talk to the lighthouse directly. When the
+  // aggregator dies the manager fails over to direct-to-root mode on its
+  // own and re-points when the root names a replacement.
+  std::string aggregator_addr;
   std::string hostname;       // advertised host for this manager
   std::string bind;           // "host:port", port 0 = ephemeral
   std::string store_addr;     // rendezvous KV store address for this replica
@@ -56,6 +61,11 @@ class ManagerServer {
   // "last_skew_ms", "last_rtt_ms", "samples"}; samples=0 until the first
   // beat round-trips against a server_ms-aware lighthouse.
   std::string clock_skew_json() const;
+
+  // Two-level control plane view: {"aggregator_addr", "via_aggregator",
+  // "direct_mode", "failovers"} — which upstream the control RPCs are using
+  // and how many aggregator->root failovers happened.
+  std::string control_status_json() const;
 
  private:
   Json handle(const std::string& method, const Json& params, TimePoint deadline);
@@ -118,6 +128,18 @@ class ManagerServer {
   // behind a long-blocking lighthouse quorum call.
   std::unique_ptr<RpcClient> heartbeat_client_;
   std::unique_ptr<RpcClient> quorum_client_;
+
+  // Aggregator failover state. agg_mu_ guards the address + clients (the
+  // root can re-point us at a replacement mid-run); shared_ptr so a beat
+  // in flight on the old client survives a concurrent re-point.
+  std::shared_ptr<RpcClient> agg_client(bool for_quorum) const;
+  void adopt_aggregator(const std::string& addr);
+  mutable std::mutex agg_mu_;
+  std::string agg_addr_;  // current aggregator ("" = flat fleet)
+  std::shared_ptr<RpcClient> agg_heartbeat_client_;
+  std::shared_ptr<RpcClient> agg_quorum_client_;
+  std::atomic<bool> agg_down_{false};
+  std::atomic<int64_t> agg_failovers_{0};
 };
 
 }  // namespace tft

@@ -57,6 +57,10 @@ struct LighthouseOpts {
   int64_t heartbeat_timeout_ms = 5000;
   // Recorded-history JSONL path (history.h); empty = disabled.
   std::string history_path;
+  // /metrics cardinality cap: per-replica series are emitted for at most
+  // this many replicas (lexicographic); the tail collapses into aggregate
+  // min/median/max series so a 1000-replica fleet can't melt the scraper.
+  int64_t metrics_per_replica_limit = 64;
 };
 
 struct MemberDetails {

@@ -136,6 +136,12 @@ void RpcServer::serve_conn(std::shared_ptr<Socket> sock) {
       try {
         Json req = Json::parse(req_text);
         std::string method = req.get("method").as_string();
+        {
+          std::lock_guard<std::mutex> lk(rx_mu_);
+          RxStat& s = rx_stats_[method];
+          s.bytes += req_text.size() + 4;  // payload + length header
+          s.calls += 1;
+        }
         int64_t timeout_ms = req.get_or("timeout_ms", Json(int64_t{60000})).as_int();
         Json params = req.get_or("params", Json::object());
         Json result = handler_(method, params, deadline_from_ms(timeout_ms));
@@ -158,6 +164,18 @@ void RpcServer::serve_conn(std::shared_ptr<Socket> sock) {
   } catch (const std::exception&) {
     // connection closed / timed out: drop it
   }
+}
+
+Json RpcServer::rx_stats() const {
+  std::lock_guard<std::mutex> lk(rx_mu_);
+  Json out = Json::object();
+  for (const auto& [method, s] : rx_stats_) {
+    Json entry = Json::object();
+    entry["bytes"] = static_cast<int64_t>(s.bytes);
+    entry["calls"] = static_cast<int64_t>(s.calls);
+    out[method] = entry;
+  }
+  return out;
 }
 
 void RpcServer::serve_http(Socket& sock, const std::string&) {

@@ -10,6 +10,7 @@
 
 #include <atomic>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <set>
@@ -48,6 +49,12 @@ class RpcServer {
   int port() const { return listener_->port(); }
   void shutdown();
 
+  // Per-method receive accounting: frame bytes (4-byte header + payload)
+  // and call counts, keyed by RPC method. This is what the fleet bench
+  // reads to measure heartbeat fan-in bytes at the root lighthouse.
+  // Returns {"<method>": {"bytes": N, "calls": N}, ...}.
+  Json rx_stats() const;
+
  private:
   void accept_loop();
   void serve_conn(std::shared_ptr<Socket> sock);
@@ -68,6 +75,13 @@ class RpcServer {
   };
   std::vector<std::unique_ptr<ConnSlot>> conn_slots_;
   void reap_finished_locked();
+
+  struct RxStat {
+    uint64_t bytes = 0;
+    uint64_t calls = 0;
+  };
+  mutable std::mutex rx_mu_;
+  std::map<std::string, RxStat> rx_stats_;
 };
 
 // Framed-JSON RPC client with a cached keep-alive connection.

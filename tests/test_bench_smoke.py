@@ -12,7 +12,10 @@ must stay under 1% of the managed step and /health must answer every
 poll made while the trainer is live. `--tracing --smoke` is the gate for
 the fleet tracing plane: span recording must stay under 1% of the
 managed step and the Prometheus /metrics endpoint must answer every
-scrape made while the trainer is live."""
+scrape made while the trainer is live. `--fleet --smoke` is the gate
+for the fleet-scale control plane: a simulated fleet (flat and two-level)
+must converge its quorum rounds and the aggregator tier must show a real
+fan-in reduction at the root."""
 
 import json
 import os
@@ -124,3 +127,14 @@ def test_bench_compressed_allreduce_smoke_emits_per_mode_splits():
     # full-size BENCH_COMPRESS.json's job
     assert rec["bandwidth_ratio_fp8"] is not None
     assert rec["bandwidth_ratio_int8"] is not None
+
+
+def test_bench_fleet_smoke_holds_fanin_and_convergence():
+    rec = _run_bench("--fleet", "--smoke")
+    # the smoke run itself gates these; re-check the load-bearing ones so a
+    # silently-weakened fleet() still fails CI
+    assert rec["fleet_fanin_ratio_at_max"] >= 2.0
+    assert rec["fleet_all_converged"] is True
+    assert rec["fleet_two_level_convergence_ms_at_max"] > 0
+    assert rec["fleet_flat_fanin_bytes_per_tick_at_max"] > 0
+    assert rec["fleet_two_level_fanin_bytes_per_tick_at_max"] > 0

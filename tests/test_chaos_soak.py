@@ -26,6 +26,7 @@ multi-peer failover).
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -37,7 +38,7 @@ import pytest
 pytestmark = pytest.mark.slow  # randomized multi-replica soak
 
 from torchft_tpu.coordination import LighthouseServer
-from torchft_tpu.manager import Manager
+from torchft_tpu.manager import AGGREGATOR_ENV, Manager
 from torchft_tpu.process_group import ProcessGroupHost, ReduceOp
 
 N_REPLICAS = 3
@@ -97,6 +98,23 @@ def test_lighthouse_restart_and_mid_heal_source_kills():
         rng, "host", "http", "dynamic", N_REPLICAS, CHAOS_SECONDS,
         target=TARGET_STEPS, lighthouse_restart=True,
         heal_source_faults=True,
+    )
+
+
+@pytest.mark.slow
+def test_aggregator_dies_mid_soak_converges_bitwise():
+    """Two-level control-plane chaos phase: the whole fleet routes beats
+    and quorum RPCs through a pod aggregator (TORCHFT_LIGHTHOUSE_AGGREGATOR,
+    the deployed-fleet configuration); chaos kills the aggregator a third
+    of the way in — managers must fail over to direct-root without losing
+    a quorum round — and brings up a replacement on a new port two thirds
+    in, which direct-beating managers re-point at via the root's
+    ``want_aggregator`` beat response. Random replica kills run throughout.
+    Same bar as every phase: finish, bitwise-equal params, >=1 heal."""
+    rng = random.Random(0xA66)
+    _run_soak_phase(
+        rng, "host", "http", "dynamic", N_REPLICAS, CHAOS_SECONDS,
+        target=TARGET_STEPS, aggregator_chaos=True,
     )
 
 
@@ -520,7 +538,7 @@ def test_link_kill_mid_collective_reroutes_and_converges():
 
 def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
                     chaos_seconds, target=20, lighthouse_restart=False,
-                    heal_source_faults=False):
+                    heal_source_faults=False, aggregator_chaos=False):
     import jax.numpy as jnp
 
     from torchft_tpu.manager import WorldSizeMode
@@ -540,6 +558,22 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
     # port is pinned so every replica's stored address stays valid
     lh_box = [lh]
     lh_port = lh.port
+    # two-level phase: every replica routes control RPCs through a pod
+    # aggregator (via TORCHFT_LIGHTHOUSE_AGGREGATOR, exactly how a deployed
+    # fleet is configured); chaos kills it mid-run and brings up a
+    # replacement on a NEW port, so the soak covers failover-to-direct AND
+    # re-pointing at the root-named replacement
+    agg_box: list = []
+    agg_env_saved = os.environ.get(AGGREGATOR_ENV)
+    if aggregator_chaos:
+        from torchft_tpu.coordination import AggregatorServer
+
+        agg = AggregatorServer(
+            root_addr=f"127.0.0.1:{lh_port}", bind="127.0.0.1:0",
+            agg_id="soak_pod", tick_ms=50, heartbeat_timeout_ms=800,
+        )
+        agg_box.append(agg)
+        os.environ[AGGREGATOR_ENV] = f"127.0.0.1:{agg.port}"
     # rid -> that incarnation's serving checkpoint transport, so chaos can
     # arm mid-serve connection drops (a heal source dying mid-transfer)
     serving: dict = {}
@@ -706,8 +740,36 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
         deadline = time.monotonic() + chaos_seconds
         restart_at = time.monotonic() + chaos_seconds / 2
         restarted = False
+        agg_killed = agg_replaced = False
+        agg_kill_at = time.monotonic() + chaos_seconds / 3
+        agg_replace_at = time.monotonic() + 2 * chaos_seconds / 3
         while time.monotonic() < deadline and not stop_chaos.is_set():
             time.sleep(rng.uniform(*KILL_PERIOD))
+            if aggregator_chaos and not agg_killed and \
+                    time.monotonic() >= agg_kill_at:
+                # the pod's aggregator dies mid-run: every manager must
+                # fail its next beat over to direct-root within the same
+                # iteration, and in-flight quorum rounds must complete
+                # against the root without the callers noticing
+                agg_killed = True
+                agg_box[0].shutdown()
+                continue
+            if aggregator_chaos and agg_killed and not agg_replaced and \
+                    time.monotonic() >= agg_replace_at:
+                # a replacement comes up on a NEW port and registers with
+                # the root; direct-beating managers learn it from the
+                # `want_aggregator` beat response and re-point
+                agg_replaced = True
+                from torchft_tpu.coordination import AggregatorServer
+
+                agg2 = AggregatorServer(
+                    root_addr=f"127.0.0.1:{lh_port}", bind="127.0.0.1:0",
+                    agg_id="soak_pod_2", tick_ms=50,
+                    heartbeat_timeout_ms=800,
+                )
+                agg_box.append(agg2)
+                os.environ[AGGREGATOR_ENV] = f"127.0.0.1:{agg2.port}"
+                continue
             if lighthouse_restart and not restarted and \
                     time.monotonic() >= restart_at:
                 # control-plane outage phase: the lighthouse process dies
@@ -764,6 +826,12 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
     finally:
         stop_chaos.set()
         ex.shutdown(wait=False, cancel_futures=True)
+        for a in agg_box:
+            a.shutdown()
+        if agg_env_saved is None:
+            os.environ.pop(AGGREGATOR_ENV, None)
+        else:
+            os.environ[AGGREGATOR_ENV] = agg_env_saved
         lh_box[0].shutdown()
 
     label = f"{plane}/{transport_kind}/{mode}"

@@ -1,0 +1,334 @@
+"""Fleet-scale control plane: the lighthouse aggregator tier.
+
+Covers the hierarchical aggregator subsystem end to end:
+
+- flat fleets stay byte-identical on the wire (golden-frame pin);
+- beats + quorum flow through an aggregator to the root and back;
+- stale ``agg_tick`` deltas are rejected after an aggregator restart;
+- an aggregator crash mid-run fails the pod over to direct-root without
+  losing the in-flight quorum round, and the root names a replacement;
+- /metrics cardinality stays bounded at 1000 fake replicas.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from torchft_tpu.coordination import (
+    AggregatorServer,
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    _RawClient,
+)
+from torchft_tpu.retry import RetryPolicy
+
+NO_RETRY = RetryPolicy(max_attempts=1)
+HEALTH_OFF = {"mode": "off"}
+
+
+def _wait_for(pred, timeout=10.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+class TestFlatWireByteIdentity:
+    def test_heartbeat_frame_is_byte_identical(self):
+        """A flat fleet must stay byte-identical on the wire with the
+        aggregator subsystem merged: capture the exact heartbeat frame a
+        LighthouseClient emits and pin it against the golden encoding
+        (4-byte big-endian length + sorted-keys compact JSON)."""
+        captured = {}
+        ready = threading.Event()
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def serve():
+            ready.set()
+            conn, _ = srv.accept()
+            with conn:
+                hdr = conn.recv(4, socket.MSG_WAITALL)
+                (n,) = struct.unpack(">I", hdr)
+                body = conn.recv(n, socket.MSG_WAITALL)
+                captured["frame"] = hdr + body
+                resp = json.dumps(
+                    {"ok": True, "result": {}},
+                    sort_keys=True, separators=(",", ":"),
+                ).encode()
+                conn.sendall(struct.pack(">I", len(resp)) + resp)
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        ready.wait(5.0)
+        try:
+            c = LighthouseClient(f"127.0.0.1:{port}", retry_policy=NO_RETRY)
+            c.heartbeat("replica_0", timeout=5.0)
+            t.join(5.0)
+            golden_body = json.dumps(
+                {
+                    "method": "heartbeat",
+                    "params": {"replica_id": "replica_0"},
+                    "timeout_ms": 5000,
+                },
+                sort_keys=True,
+                separators=(",", ":"),
+            ).encode()
+            golden = struct.pack(">I", len(golden_body)) + golden_body
+            assert captured["frame"] == golden
+        finally:
+            srv.close()
+
+
+class TestAggregatorTier:
+    def test_beats_and_quorum_flow_through_aggregator(self):
+        """Two pod replicas point only at the aggregator; their beats and
+        telemetry must surface at the root, and a quorum round resolves
+        through the tier (delta-encoded: repeated same-step telemetry is
+        forwarded once)."""
+        root = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=200,
+            quorum_tick_ms=20, health=HEALTH_OFF,
+        )
+        root_addr = f"127.0.0.1:{root.port}"
+        agg = AggregatorServer(
+            root_addr=root_addr, bind="127.0.0.1:0", agg_id="podA",
+            tick_ms=50,
+        )
+        agg_addr = f"127.0.0.1:{agg.port}"
+        try:
+            c1 = LighthouseClient(agg_addr, retry_policy=NO_RETRY)
+            c2 = LighthouseClient(agg_addr, retry_policy=NO_RETRY)
+            root_c = LighthouseClient(root_addr, retry_policy=NO_RETRY)
+            c1.heartbeat("rep_a", telemetry={"step": 1, "step_s": 0.5})
+            c2.heartbeat("rep_b")
+            _wait_for(
+                lambda: {"rep_a", "rep_b"}.issubset(
+                    root_c.status()["heartbeat_ages_ms"]
+                ),
+                msg="pod beats reaching root",
+            )
+            st = root_c.status()
+            assert "podA" in st["aggregators"]
+            assert st["aggregators"]["podA"]["live"] == 2
+            # The root saw agg_tick traffic, not direct heartbeats.
+            assert st["rx"].get("agg_tick", {}).get("calls", 0) > 0
+            assert st["rx"].get("heartbeat", {}).get("calls", 0) == 0
+
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                f1 = ex.submit(c1.quorum, "rep_a", 10.0, "a:1", "s:1", 3)
+                f2 = ex.submit(c2.quorum, "rep_b", 10.0, "b:1", "s:2", 3)
+                q1, q2 = f1.result(), f2.result()
+            assert q1.quorum_id == q2.quorum_id
+            rids = sorted(m.replica_id for m in q1.participants)
+            assert rids == ["rep_a", "rep_b"]
+            # Member payloads survived the tier intact.
+            byid = {m.replica_id: m for m in q2.participants}
+            assert byid["rep_a"].address == "a:1"
+            assert byid["rep_a"].step == 3
+        finally:
+            agg.shutdown()
+            root.shutdown()
+
+    def test_stale_delta_rejected_after_restart(self):
+        """agg_tick frames carry (epoch, seq); the root rejects replays and
+        frames from a dead incarnation so a restarted aggregator's stray
+        in-flight delta cannot resurrect a superseded live set."""
+        root = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, health=HEALTH_OFF,
+        )
+        try:
+            c = _RawClient(f"127.0.0.1:{root.port}", retry_policy=NO_RETRY)
+
+            def tick(epoch, seq, **extra):
+                params = {
+                    "agg_id": "podX", "addr": "127.0.0.1:1", "epoch": epoch,
+                    "seq": seq, "quorum_gen_seen": 0, **extra,
+                }
+                return c.call("agg_tick", params, timeout=5.0, retry=False)
+
+            tick(100, 1, beats=["r1", "r2"])
+            with pytest.raises(ValueError):  # replayed seq
+                tick(100, 1, beats=["r1"])
+            with pytest.raises(ValueError):  # reordered seq
+                tick(100, 0, beats=["r1"])
+            with pytest.raises(ValueError):  # older incarnation
+                tick(99, 50, beats=["r9"])
+            # New incarnation resets the delta state: beats_same has no
+            # baseline to reuse, so the root must fail the tick (which makes
+            # the restarted aggregator re-send its full live set).
+            with pytest.raises(ValueError):
+                tick(101, 1, beats_same=True)
+            tick(101, 2, beats=["r1"])  # full resend accepted
+        finally:
+            root.shutdown()
+
+    def test_metrics_cardinality_bounded_at_1000_replicas(self):
+        """1000 fake replicas beat once; /metrics must stay bounded: at most
+        ``metrics_per_replica_limit`` per-replica heartbeat series plus a
+        three-series aggregate tail, never 1000 lines."""
+        root = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, health=HEALTH_OFF,
+            metrics_per_replica_limit=16,
+        )
+        try:
+            c = _RawClient(f"127.0.0.1:{root.port}", retry_policy=NO_RETRY)
+            for i in range(1000):
+                c.call_raw(
+                    "heartbeat",
+                    json.dumps({"replica_id": f"r{i:04d}"}).encode(),
+                    timeout=5.0, retry=False,
+                )
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{root.port}/metrics", timeout=10.0
+            ) as resp:
+                text = resp.read().decode()
+            per_replica = [
+                l for l in text.splitlines()
+                if l.startswith("torchft_lighthouse_heartbeat_age_ms{")
+                and '_tail' not in l
+            ]
+            tail = [
+                l for l in text.splitlines()
+                if l.startswith(
+                    'torchft_lighthouse_heartbeat_age_ms{replica="_tail"'
+                )
+            ]
+            assert len(per_replica) == 16
+            assert len(tail) == 3  # min / median / max
+            assert 'torchft_lighthouse_heartbeat_replicas 1000' in text
+            assert 'torchft_lighthouse_metrics_replica_limit 16' in text
+        finally:
+            root.shutdown()
+
+
+class TestAggregatorFailover:
+    def test_crash_mid_tick_falls_back_without_losing_quorum_round(self):
+        """Kill the aggregator while its pod is mid-quorum: the managers
+        must fail over to direct root within the same round (no retry from
+        the caller), and their control status must show the fallback."""
+        root = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=200,
+            quorum_tick_ms=20, health=HEALTH_OFF,
+        )
+        root_addr = f"127.0.0.1:{root.port}"
+        agg = AggregatorServer(
+            root_addr=root_addr, bind="127.0.0.1:0", agg_id="podF",
+            tick_ms=50,
+        )
+        agg_addr = f"127.0.0.1:{agg.port}"
+        mgr_a = ManagerServer(
+            replica_id="rep_a", lighthouse_addr=root_addr,
+            hostname="127.0.0.1", bind="127.0.0.1:0", store_addr="sa",
+            world_size=1, aggregator_addr=agg_addr,
+        )
+        mgr_b = ManagerServer(
+            replica_id="rep_b", lighthouse_addr=root_addr,
+            hostname="127.0.0.1", bind="127.0.0.1:0", store_addr="sb",
+            world_size=1, aggregator_addr=agg_addr,
+        )
+        try:
+            root_c = LighthouseClient(root_addr, retry_policy=NO_RETRY)
+            _wait_for(
+                lambda: {"rep_a", "rep_b"}.issubset(
+                    root_c.status()["heartbeat_ages_ms"]
+                ),
+                msg="pod beats reaching root via aggregator",
+            )
+            assert mgr_a.control_status()["via_aggregator"]
+            # Crash the aggregator mid-tick, then immediately demand a
+            # quorum round: both managers must resolve it direct-to-root
+            # within this single call (timeout is the round budget).
+            agg.shutdown()
+            ca = ManagerClient(f"127.0.0.1:{mgr_a.port}")
+            cb = ManagerClient(f"127.0.0.1:{mgr_b.port}")
+            with ThreadPoolExecutor(max_workers=2) as ex:
+                fa = ex.submit(ca._quorum, 0, 0, "meta_a", False, 20.0)
+                fb = ex.submit(cb._quorum, 0, 0, "meta_b", False, 20.0)
+                ra, rb = fa.result(), fb.result()
+            assert ra.quorum_id == rb.quorum_id
+            assert ra.replica_world_size == 2
+            cs = mgr_a.control_status()
+            assert cs["direct_mode"] or cs["failovers"] >= 1
+        finally:
+            mgr_a.shutdown()
+            mgr_b.shutdown()
+            agg.shutdown()
+            root.shutdown()
+
+    def test_root_names_replacement_aggregator(self):
+        """A direct heartbeat asking ``want_aggregator`` gets the freshest
+        live aggregator back — how a failed-over manager re-points."""
+        root = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, health=HEALTH_OFF,
+        )
+        root_addr = f"127.0.0.1:{root.port}"
+        agg = AggregatorServer(
+            root_addr=root_addr, bind="127.0.0.1:0", agg_id="podR",
+            tick_ms=50,
+        )
+        try:
+            c = _RawClient(root_addr, retry_policy=NO_RETRY)
+            _wait_for(
+                lambda: "podR" in c.call("status", {}, 5.0)["aggregators"],
+                msg="aggregator registering at root",
+            )
+            resp = c.call(
+                "heartbeat",
+                {"replica_id": "rep_solo", "want_aggregator": True},
+                timeout=5.0, retry=False,
+            )
+            assert resp.get("aggregator", "").endswith(str(agg.port))
+            # Flat-fleet beats (no want_aggregator) stay untouched.
+            resp2 = c.call(
+                "heartbeat", {"replica_id": "rep_solo"}, timeout=5.0,
+                retry=False,
+            )
+            assert "aggregator" not in resp2
+        finally:
+            agg.shutdown()
+            root.shutdown()
+
+
+class TestDoctorAggregatorCheck:
+    """Env-wiring half of doctor's `aggregator` check (the loopback probe
+    half runs in the doctor CLI test)."""
+
+    def test_malformed_addr_fails(self, monkeypatch):
+        from torchft_tpu.doctor import check_aggregator
+
+        monkeypatch.setenv("TORCHFT_LIGHTHOUSE_AGGREGATOR", "no-port-here")
+        ok, detail = check_aggregator()
+        assert ok is False
+        assert "host:port" in detail
+
+    def test_aggregator_without_root_fails(self, monkeypatch):
+        from torchft_tpu.doctor import check_aggregator
+
+        monkeypatch.setenv("TORCHFT_LIGHTHOUSE_AGGREGATOR", "10.0.0.1:29520")
+        monkeypatch.delenv("TORCHFT_LIGHTHOUSE", raising=False)
+        ok, detail = check_aggregator()
+        assert ok is False
+        assert "fail over" in detail
+
+    def test_well_formed_two_level_probes_ok(self, monkeypatch):
+        from torchft_tpu.doctor import check_aggregator
+
+        monkeypatch.setenv("TORCHFT_LIGHTHOUSE_AGGREGATOR", "10.0.0.1:29520")
+        monkeypatch.setenv("TORCHFT_LIGHTHOUSE", "10.0.0.2:29510")
+        ok, detail = check_aggregator()
+        assert ok is True
+        assert "two-level" in detail and "agg_tick" in detail

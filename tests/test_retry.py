@@ -223,3 +223,77 @@ class TestRetryCall:
             retry_call(fn, timeout=5.0)
         assert ei.value is err
         assert len(calls) == 1
+
+
+class TestFullJitter:
+    """Reconnect thundering-herd: connection-loss retries use FULL jitter
+    (uniform [0, ceiling]) so a fleet of clients dropped by one server
+    restart spreads its reconnects across the whole backoff window instead
+    of re-packing into the top half of it."""
+
+    def test_full_jitter_draws_span_whole_window(self):
+        p = RetryPolicy(max_attempts=5, base_s=0.2, max_backoff_s=1.0, jitter=0.5)
+        rng = random.Random(99)
+        bounded_floor = 0.1  # (1 - jitter) * ceiling for attempt 2
+        draws = [p.backoff_s(2, rng, full=True) for _ in range(300)]
+        assert all(0.0 <= d <= 0.2 for d in draws)
+        # The whole point: a real share of draws lands where the bounded
+        # band can never go (below (1-jitter)*ceiling).
+        below = [d for d in draws if d < bounded_floor]
+        assert len(below) > 100
+        assert min(draws) < 0.02 and max(draws) > 0.18
+
+    def test_herd_of_clients_decorrelates(self):
+        """Simulate a server restart dropping 50 clients at once: with full
+        jitter their first-retry sleeps cover the whole window; with the
+        bounded default they all land in the top half — the herd."""
+        p = RetryPolicy(max_attempts=2, base_s=0.5, max_backoff_s=0.5, jitter=0.5)
+        full_sleeps, bounded_sleeps = [], []
+        for seed in range(50):
+            for sleeps, use_full in ((full_sleeps, True), (bounded_sleeps, False)):
+                clk = FakeClock()
+
+                def fn(remaining):
+                    raise ConnectionError("server restarted")
+
+                with pytest.raises(RetryBudgetExhausted):
+                    retry_call(
+                        fn,
+                        p,
+                        timeout=10.0,
+                        full_jitter_on=(ConnectionError,) if use_full else (),
+                        rng=random.Random(seed),
+                        clock=clk.clock,
+                        sleep=clk.sleep,
+                    )
+                assert len(clk.sleeps) == 1
+                sleeps.append(clk.sleeps[0])
+        # Bounded band: every sleep in [0.25, 0.5] — the packed herd.
+        assert all(0.25 <= s <= 0.5 for s in bounded_sleeps)
+        # Full jitter: same clients spread over [0, 0.5], with a solid
+        # fraction below the bounded band's floor.
+        assert all(0.0 <= s <= 0.5 for s in full_sleeps)
+        assert sum(1 for s in full_sleeps if s < 0.25) >= 15
+
+    def test_full_jitter_only_for_selected_exceptions(self):
+        """A TimeoutError retry keeps the bounded band even when
+        connection-loss classes are enrolled for full jitter."""
+        p = RetryPolicy(max_attempts=4, base_s=0.5, max_backoff_s=0.5, jitter=0.5)
+        clk = FakeClock()
+
+        def fn(remaining):
+            raise TimeoutError("slow, not disconnected")
+
+        with pytest.raises(RetryBudgetExhausted):
+            retry_call(
+                fn,
+                p,
+                timeout=30.0,
+                retryable=(TimeoutError,),
+                full_jitter_on=(ConnectionError,),
+                rng=random.Random(7),
+                clock=clk.clock,
+                sleep=clk.sleep,
+            )
+        assert len(clk.sleeps) == 3
+        assert all(0.25 <= s <= 0.5 for s in clk.sleeps)

@@ -1,0 +1,542 @@
+"""Massive-fleet control-plane simulation harness.
+
+Drives the *real* lighthouse / aggregator wire protocol with 1000+
+lightweight fake replicas on loopback — no JAX, no training step, just the
+control plane under fleet-scale load. Each fake replica is a prebuilt
+heartbeat frame plus (during the quorum phase) one raw TCP socket holding a
+blocked ``quorum`` RPC, so a single host can stand in for a fleet that
+would otherwise need a thousand machines.
+
+What it measures per run (one topology x one fleet size):
+
+- **root fan-in bytes/s** during a beats-only steady-state window, read
+  from the root's per-method rx counters (``heartbeat`` for a flat fleet,
+  ``agg_tick`` for a two-level one);
+- **quorum convergence latency**: all live replicas fire a fire-and-forget
+  ``quorum`` join (frames written, responses not yet read), then the
+  harness selects over all sockets — convergence is first-ok-response
+  minus last-join-sent (the quorum is decided and fanning out), and
+  ``quorum_delivery_ms`` is last-response-received, which at 1000
+  replicas is dominated by draining O(n^2) response bytes through one
+  loopback CPU rather than by the control plane itself;
+- **/health and /metrics scrape** latency/throughput over HTTP on the same
+  port while the fleet keeps beating;
+- optional **churn**: kill k replicas (stop their beats), enroll k fresh
+  ones, and re-run the quorum round — re-convergence is honest about the
+  heartbeat-expiry wait for the dead cohort.
+
+Topologies:
+
+- ``flat``    — every replica beats the root lighthouse directly;
+- ``two_level`` — replicas beat pod aggregators (``AggregatorServer``),
+  which batch + delta-encode upstream into one ``agg_tick`` per tick.
+
+Sized for a 1-vCPU CI box: beats are sent by a small bounded worker pool
+(one cached RPC connection per worker per target, retries disabled), the
+health ledger runs in ``off`` mode, and the quorum phase is event-driven
+(one selector thread over N sockets) rather than N blocked client threads.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import selectors
+import socket
+import struct
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from torchft_tpu.coordination import (
+    AggregatorServer,
+    LighthouseServer,
+    _RawClient,
+)
+from torchft_tpu.healthwatch import HealthConfig
+from torchft_tpu.retry import RetryPolicy
+
+_NO_RETRY = RetryPolicy(max_attempts=1)
+
+
+def _hostport(addr: str) -> Tuple[str, int]:
+    """``http://host:port`` / ``host:port`` -> ``(host, port)``."""
+    if "://" in addr:
+        addr = addr.split("://", 1)[1]
+    host, _, port = addr.rpartition(":")
+    host = host.strip("[]") or "127.0.0.1"
+    if host in ("0.0.0.0", "::"):
+        host = "127.0.0.1"
+    return host, int(port)
+
+
+def _raise_fd_limit(want: int = 65535) -> None:
+    """The quorum phase holds one socket per replica (plus the server side
+    of each) — lift RLIMIT_NOFILE toward ``want`` so 1000-replica runs
+    don't die on EMFILE. Best-effort: capped at the hard limit."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        target = min(want, hard) if hard > 0 else want
+        if soft < target:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (target, hard))
+    except Exception:
+        pass
+
+
+@dataclass
+class FleetConfig:
+    """Knobs for one simulation run (one topology x one fleet size)."""
+
+    n_replicas: int = 100
+    topology: str = "flat"  # "flat" | "two_level"
+    n_aggregators: int = 0  # two_level only; 0 -> ceil(n / 64)
+    beat_interval_s: float = 1.0
+    step_interval_s: float = 10.0  # telemetry step cadence (delta trigger)
+    beat_workers: int = 8
+    heartbeat_timeout_ms: int = 5000
+    quorum_tick_ms: int = 50
+    join_timeout_ms: int = 30000
+    agg_tick_ms: int = 250
+    measure_s: float = 5.0  # beats-only fan-in window
+    scrape_iters: int = 25
+    churn_replicas: int = 0
+    quorum_rpc_timeout_ms: int = 60000
+    quorum_rounds: int = 3  # median over rounds (tick-phase noise)
+    convergence_timeout_s: float = 120.0
+    warmup_timeout_s: float = 60.0
+
+
+@dataclass
+class _FakeReplica:
+    rid: str
+    target: str  # "host:port" this replica beats / joins through
+    step: int = 0
+    next_step_t: float = 0.0
+    dead: bool = False
+    frame: bytes = b""
+
+    def telemetry(self) -> dict:
+        # Shaped like the manager's per-step healthwatch payload so frame
+        # sizes (and the aggregator's step-delta encoding) are realistic.
+        return {
+            "host": f"host-{self.rid}",
+            "step": self.step,
+            "step_time_s": 0.5,
+            "wire_time_s": 0.05,
+        }
+
+    def rebuild_frame(self) -> None:
+        self.frame = json.dumps(
+            {"replica_id": self.rid, "telemetry": self.telemetry()},
+            sort_keys=True,
+            separators=(",", ":"),
+        ).encode()
+
+    def maybe_beat(self, client: _RawClient, now: float) -> None:
+        if now >= self.next_step_t:
+            self.step += 1
+            self.next_step_t = now + _STEP_INTERVAL_HOLDER[0]
+            self.rebuild_frame()
+        client.call_raw("heartbeat", self.frame, timeout=5.0, retry=False)
+
+
+# maybe_beat is called from worker threads with the config's step interval;
+# stash it module-level so _FakeReplica stays a plain dataclass.
+_STEP_INTERVAL_HOLDER = [10.0]
+
+
+class _BeatWorker(threading.Thread):
+    """Owns a slice of the fleet; sends each replica's beat once per
+    ``beat_interval_s`` round over one cached connection per target."""
+
+    def __init__(self, name: str, replicas: List[_FakeReplica],
+                 interval_s: float, stop: threading.Event):
+        super().__init__(name=name, daemon=True)
+        self.replicas = replicas
+        self.interval_s = interval_s
+        self.stop_event = stop
+        self.beats = 0
+        self.errors = 0
+        self._clients: Dict[str, _RawClient] = {}
+
+    def _client(self, target: str) -> _RawClient:
+        c = self._clients.get(target)
+        if c is None:
+            c = _RawClient(target, connect_timeout=10.0, retry_policy=_NO_RETRY)
+            self._clients[target] = c
+        return c
+
+    def run(self) -> None:
+        while not self.stop_event.is_set():
+            start = time.monotonic()
+            for r in list(self.replicas):
+                if self.stop_event.is_set():
+                    return
+                if r.dead:
+                    continue
+                try:
+                    r.maybe_beat(self._client(r.target), time.monotonic())
+                    self.beats += 1
+                except Exception:
+                    self.errors += 1
+            elapsed = time.monotonic() - start
+            self.stop_event.wait(max(0.0, self.interval_s - elapsed))
+
+
+class FleetSim:
+    """One simulated fleet: a root lighthouse, optional aggregator tier,
+    and ``n_replicas`` fake replicas beating through a worker pool."""
+
+    def __init__(self, cfg: FleetConfig):
+        if cfg.topology not in ("flat", "two_level"):
+            raise ValueError(f"unknown topology: {cfg.topology!r}")
+        _raise_fd_limit()
+        self.cfg = cfg
+        _STEP_INTERVAL_HOLDER[0] = cfg.step_interval_s
+        self.root = LighthouseServer(
+            bind="127.0.0.1:0",
+            min_replicas=cfg.n_replicas,
+            join_timeout_ms=cfg.join_timeout_ms,
+            quorum_tick_ms=cfg.quorum_tick_ms,
+            heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+            health=HealthConfig(mode="off").to_json(),
+            metrics_per_replica_limit=64,
+        )
+        root_host, root_port = _hostport(self.root.address())
+        self.root_target = f"{root_host}:{root_port}"
+        self.aggregators: List[AggregatorServer] = []
+        targets = [self.root_target]
+        if cfg.topology == "two_level":
+            n_agg = cfg.n_aggregators or max(1, math.ceil(cfg.n_replicas / 64))
+            targets = []
+            for i in range(n_agg):
+                agg = AggregatorServer(
+                    root_addr=self.root_target,
+                    bind="127.0.0.1:0",
+                    agg_id=f"agg{i:02d}",
+                    tick_ms=cfg.agg_tick_ms,
+                    heartbeat_timeout_ms=cfg.heartbeat_timeout_ms,
+                )
+                self.aggregators.append(agg)
+                h, p = _hostport(agg.address())
+                targets.append(f"{h}:{p}")
+        self.replicas: List[_FakeReplica] = [
+            _FakeReplica(rid=f"r{i:04d}", target=targets[i % len(targets)])
+            for i in range(cfg.n_replicas)
+        ]
+        self._targets = targets
+        self._churn_serial = 0
+        self._stop = threading.Event()
+        self.workers: List[_BeatWorker] = []
+        n_workers = max(1, min(cfg.beat_workers, cfg.n_replicas))
+        for w in range(n_workers):
+            self.workers.append(_BeatWorker(
+                name=f"fleet-beats-{w}",
+                replicas=self.replicas[w::n_workers],
+                interval_s=cfg.beat_interval_s,
+                stop=self._stop,
+            ))
+        self._status_client = _RawClient(
+            self.root_target, connect_timeout=10.0, retry_policy=_NO_RETRY
+        )
+
+    # ---------------------------------------------------------------- beats
+
+    def start(self) -> None:
+        for w in self.workers:
+            w.start()
+
+    def live_replicas(self) -> List[_FakeReplica]:
+        return [r for r in self.replicas if not r.dead]
+
+    def root_status(self) -> dict:
+        return self._status_client.call("status", {}, timeout=10.0)
+
+    def wait_all_beating(self) -> float:
+        """Block until the root has a heartbeat for every live replica
+        (through the aggregator tier when two-level); returns how long the
+        warmup took."""
+        want = {r.rid for r in self.live_replicas()}
+        deadline = time.monotonic() + self.cfg.warmup_timeout_s
+        t0 = time.monotonic()
+        while time.monotonic() < deadline:
+            beats = self.root_status().get("heartbeat_ages_ms", {})
+            if want.issubset(beats.keys()):
+                return time.monotonic() - t0
+            time.sleep(0.2)
+        missing = sorted(
+            want - set(self.root_status().get("heartbeat_ages_ms", {}))
+        )
+        raise TimeoutError(
+            f"warmup: {len(missing)} replicas never reached the root "
+            f"(first few: {missing[:5]})"
+        )
+
+    # ------------------------------------------------------------- fan-in
+
+    def _rx(self) -> Dict[str, dict]:
+        return self.root_status().get("rx", {})
+
+    def measure_fanin(self) -> dict:
+        """Beats-only steady-state window: per-method root rx deltas."""
+        a = self._rx()
+        t0 = time.monotonic()
+        time.sleep(self.cfg.measure_s)
+        b = self._rx()
+        dt = time.monotonic() - t0
+        out: Dict[str, float] = {}
+        for method in ("heartbeat", "agg_tick"):
+            d_bytes = b.get(method, {}).get("bytes", 0) - a.get(method, {}).get("bytes", 0)
+            d_calls = b.get(method, {}).get("calls", 0) - a.get(method, {}).get("calls", 0)
+            out[f"rx_{method}_bytes_per_s"] = d_bytes / dt
+            out[f"rx_{method}_calls_per_s"] = d_calls / dt
+        beat_plane = (
+            out["rx_heartbeat_bytes_per_s"] + out["rx_agg_tick_bytes_per_s"]
+        )
+        out["root_fanin_bytes_per_s"] = beat_plane
+        # Normalized to one fleet-wide beat interval ("per tick"): what the
+        # root ingests for one round of everyone beating once.
+        out["root_fanin_bytes_per_tick"] = beat_plane * self.cfg.beat_interval_s
+        out["window_s"] = dt
+        return out
+
+    # ------------------------------------------------------------- quorum
+
+    def quorum_round(self) -> dict:
+        """Fire a fire-and-forget ``quorum`` join from every live replica,
+        then select over all sockets until every response frame lands."""
+        cfg = self.cfg
+        live = self.live_replicas()
+        socks: Dict[socket.socket, dict] = {}
+        sel = selectors.DefaultSelector()
+        send_errors = 0
+        try:
+            for r in live:
+                member = {
+                    "replica_id": r.rid,
+                    "address": f"fake://{r.rid}",
+                    "store_address": f"fake://{r.rid}:0",
+                    "step": r.step,
+                    "world_size": 1,
+                    "shrink_only": False,
+                    "commit_failures": 0,
+                    "data": "",
+                }
+                payload = json.dumps(
+                    {
+                        "method": "quorum",
+                        "params": {"requester": member},
+                        "timeout_ms": cfg.quorum_rpc_timeout_ms,
+                    },
+                    sort_keys=True,
+                    separators=(",", ":"),
+                ).encode()
+                try:
+                    s = socket.create_connection(
+                        _hostport(r.target), timeout=10.0
+                    )
+                    s.sendall(struct.pack(">I", len(payload)) + payload)
+                    s.setblocking(False)
+                    socks[s] = {"rid": r.rid, "buf": bytearray(), "ok": None}
+                    sel.register(s, selectors.EVENT_READ)
+                except OSError:
+                    send_errors += 1
+            t_sent = time.monotonic()
+            pending = len(socks)
+            n_ok = 0
+            deadline = t_sent + cfg.convergence_timeout_s
+            t_first = None
+            t_done = None
+            while pending > 0 and time.monotonic() < deadline:
+                for key, _ in sel.select(timeout=0.25):
+                    s = key.fileobj
+                    st = socks[s]
+                    if st["ok"] is not None:
+                        continue
+                    try:
+                        chunk = s.recv(1 << 18)
+                    except BlockingIOError:
+                        continue
+                    except OSError:
+                        chunk = b""
+                    if not chunk:
+                        st["ok"] = False
+                        pending -= 1
+                        sel.unregister(s)
+                        continue
+                    st["buf"] += chunk
+                    buf = st["buf"]
+                    if len(buf) >= 4:
+                        (need,) = struct.unpack(">I", bytes(buf[:4]))
+                        if len(buf) >= 4 + need:
+                            # Response dump is sorted-keys JSON: an ok
+                            # response starts {"ok":true,...} — enough to
+                            # classify without parsing 1000 full quorums.
+                            st["ok"] = bytes(buf[4:14]).startswith(b'{"ok":true')
+                            n_ok += 1 if st["ok"] else 0
+                            pending -= 1
+                            sel.unregister(s)
+                            t_done = time.monotonic()
+                            if st["ok"] and t_first is None:
+                                t_first = t_done
+            converged = pending == 0 and n_ok == len(socks) and len(socks) == len(live)
+            if t_done is None:
+                t_done = time.monotonic()
+            if t_first is None:
+                t_first = t_done
+            # Convergence = the quorum decision exists and is being fanned
+            # out (first ok response after the last join was issued).
+            # Delivery = every replica has drained its response; at 1000
+            # replicas each response carries the full member list, so the
+            # drain is O(n^2) bytes through one loopback CPU — report it
+            # separately rather than letting harness serialization masquerade
+            # as control-plane latency.
+            return {
+                "quorum_joined": len(socks),
+                "quorum_ok": n_ok,
+                "quorum_send_errors": send_errors,
+                "quorum_converged": converged,
+                "quorum_convergence_ms": (t_first - t_sent) * 1000.0,
+                "quorum_delivery_ms": (t_done - t_sent) * 1000.0,
+            }
+        finally:
+            sel.close()
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------- scrape
+
+    def scrape(self) -> dict:
+        """Hit GET /health and /metrics on the root while beats continue."""
+        host, port = _hostport(self.root_target)
+        out: Dict[str, float] = {}
+        for path in ("/health", "/metrics"):
+            lat: List[float] = []
+            size = 0
+            t0 = time.monotonic()
+            for _ in range(self.cfg.scrape_iters):
+                t1 = time.monotonic()
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=10.0
+                ) as resp:
+                    body = resp.read()
+                size = len(body)
+                lat.append((time.monotonic() - t1) * 1000.0)
+            wall = time.monotonic() - t0
+            lat.sort()
+            key = path.strip("/")
+            out[f"scrape_{key}_p50_ms"] = lat[len(lat) // 2]
+            out[f"scrape_{key}_rps"] = self.cfg.scrape_iters / wall
+            out[f"scrape_{key}_bytes"] = float(size)
+        return out
+
+    # -------------------------------------------------------------- churn
+
+    def churn(self, k: Optional[int] = None) -> dict:
+        """Kill ``k`` replicas (their beats stop mid-flight), enroll ``k``
+        fresh ones, and run another quorum round. Re-convergence includes
+        the heartbeat-expiry wait for the dead cohort — that is the honest
+        number an operator would see."""
+        k = self.cfg.churn_replicas if k is None else k
+        if k <= 0:
+            return {}
+        live = self.live_replicas()
+        victims = live[:: max(1, len(live) // k)][:k]
+        for v in victims:
+            v.dead = True
+        fresh: List[_FakeReplica] = []
+        for _ in range(k):
+            self._churn_serial += 1
+            r = _FakeReplica(
+                rid=f"c{self._churn_serial:04d}",
+                target=self._targets[self._churn_serial % len(self._targets)],
+            )
+            fresh.append(r)
+            self.replicas.append(r)
+        # Hand the fresh cohort to the beat workers round-robin, then give
+        # them a beat round to register before they join.
+        for i, r in enumerate(fresh):
+            self.workers[i % len(self.workers)].replicas.append(r)
+        t_kill = time.monotonic()
+        self.wait_all_beating()
+        round2 = self.quorum_round()
+        return {
+            "churn_killed": float(len(victims)),
+            "churn_added": float(len(fresh)),
+            "churn_reconverge_ms": round2["quorum_convergence_ms"],
+            "churn_converged": round2["quorum_converged"],
+            "churn_total_ms": (time.monotonic() - t_kill) * 1000.0
+            + round2["quorum_convergence_ms"],
+        }
+
+    # ------------------------------------------------------------ teardown
+
+    def aggregator_stats(self) -> dict:
+        if not self.aggregators:
+            return {}
+        stats = [a.status() for a in self.aggregators]
+        return {
+            "agg_count": float(len(stats)),
+            "agg_ticks_ok": float(sum(s.get("ticks_ok", 0) for s in stats)),
+            "agg_ticks_failed": float(
+                sum(s.get("ticks_failed", 0) for s in stats)
+            ),
+            "agg_upstream_bytes": float(
+                sum(s.get("upstream_bytes", 0) for s in stats)
+            ),
+        }
+
+    def beat_stats(self) -> dict:
+        return {
+            "beats_sent": float(sum(w.beats for w in self.workers)),
+            "beat_errors": float(sum(w.errors for w in self.workers)),
+        }
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for w in self.workers:
+            w.join(timeout=10.0)
+        for a in self.aggregators:
+            a.shutdown()
+        self.root.shutdown()
+
+
+def run_fleet(cfg: FleetConfig) -> dict:
+    """Full measurement sequence for one (topology, size) point."""
+    sim = FleetSim(cfg)
+    try:
+        sim.start()
+        warmup_s = sim.wait_all_beating()
+        metrics: Dict[str, object] = {
+            "n_replicas": cfg.n_replicas,
+            "topology": cfg.topology,
+            "n_aggregators": len(sim.aggregators),
+            "beat_interval_s": cfg.beat_interval_s,
+            "quorum_tick_ms": cfg.quorum_tick_ms,
+            "warmup_s": warmup_s,
+        }
+        metrics.update(sim.measure_fanin())
+        # Convergence is phase-sensitive: the decision lands on the next
+        # aggregator tick after the final join, so a single round samples a
+        # uniform(0, tick) delay. Median a few rounds for a stable number.
+        rounds = [sim.quorum_round() for _ in range(max(1, cfg.quorum_rounds))]
+        rounds.sort(key=lambda r: r["quorum_convergence_ms"])
+        mid = rounds[len(rounds) // 2]
+        metrics.update(mid)
+        metrics["quorum_converged"] = all(r["quorum_converged"] for r in rounds)
+        metrics["quorum_rounds"] = len(rounds)
+        metrics.update(sim.scrape())
+        if cfg.churn_replicas > 0:
+            metrics.update(sim.churn())
+        metrics.update(sim.aggregator_stats())
+        metrics.update(sim.beat_stats())
+        return metrics
+    finally:
+        sim.shutdown()

@@ -1,0 +1,77 @@
+"""Standalone lighthouse-aggregator CLI (two-level control plane).
+
+Run one aggregator per pod of replicas::
+
+    python -m torchft_tpu.aggregator --root http://roothost:29510 \
+        --bind 0.0.0.0:29520
+
+Pod workers point at it via ``TORCHFT_LIGHTHOUSE_AGGREGATOR=host:29520``
+(keeping ``TORCHFT_LIGHTHOUSE`` set to the root for failover) — the manager
+speaks the same wire protocol to an aggregator as to a lighthouse, so no
+other configuration changes. Upstream, the aggregator batches the whole
+pod's heartbeats/telemetry into one delta-encoded ``agg_tick`` RPC per tick
+and fans quorum results back out. The same port serves ``GET /status``
+JSON (pod size / live set / upstream tick counters).
+
+Sizing rule of thumb: one aggregator per 32-64 replicas keeps both the pod
+fan-in and the root's aggregator count comfortable (see
+docs/operations.md, "Running a fleet").
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from torchft_tpu.coordination import AggregatorServer
+
+# Managers read this to point control RPCs at a pod aggregator (manager.py
+# re-exports it as AGGREGATOR_ENV).
+AGGREGATOR_ENV = "TORCHFT_LIGHTHOUSE_AGGREGATOR"
+
+
+def main(argv: "list[str] | None" = None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="torchft_tpu_aggregator", description=__doc__
+    )
+    parser.add_argument(
+        "--root",
+        required=True,
+        help="root lighthouse address (host:port; http:// prefix tolerated)",
+    )
+    parser.add_argument("--bind", default="0.0.0.0:29520")
+    parser.add_argument(
+        "--agg-id", "--agg_id", default="", help="stable aggregator id "
+        "(default: derived from the bind address)"
+    )
+    parser.add_argument(
+        "--tick-ms", "--tick_ms", type=int, default=100,
+        help="upstream batching cadence (one agg_tick RPC per tick)",
+    )
+    parser.add_argument(
+        "--heartbeat-timeout-ms", "--heartbeat_timeout_ms", type=int,
+        default=5000, help="pod-liveness horizon; match the root lighthouse",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    server = AggregatorServer(
+        root_addr=args.root,
+        bind=args.bind,
+        agg_id=args.agg_id,
+        tick_ms=args.tick_ms,
+        heartbeat_timeout_ms=args.heartbeat_timeout_ms,
+    )
+    logging.info("aggregator listening at %s (root %s)", server.address(), args.root)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

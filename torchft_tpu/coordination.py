@@ -29,6 +29,7 @@ __all__ = [
     "FallbackPeer",
     "LighthouseServer",
     "LighthouseClient",
+    "AggregatorServer",
     "ManagerServer",
     "ManagerClient",
     "KvStoreServer",
@@ -37,6 +38,9 @@ __all__ = [
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "_native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libtorchft_tpu.so")
+
+# /metrics per-replica series cap (see LighthouseServer / docs/operations.md).
+METRICS_PER_REPLICA_LIMIT_ENV = "TORCHFT_METRICS_PER_REPLICA_LIMIT"
 
 # status codes from native/capi.cc
 _OK, _TIMEOUT, _ERROR, _NOT_FOUND, _INVALID, _UNAVAILABLE = range(6)
@@ -92,10 +96,23 @@ def _load() -> ctypes.CDLL:
         lib.tft_lighthouse_port.argtypes = [ctypes.c_void_p]
         lib.tft_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
         lib.tft_lighthouse_free.argtypes = [ctypes.c_void_p]
+        lib.tft_aggregator_new.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_aggregator_address.argtypes = [ctypes.c_void_p]
+        lib.tft_aggregator_address.restype = ctypes.c_void_p
+        lib.tft_aggregator_status.argtypes = [ctypes.c_void_p]
+        lib.tft_aggregator_status.restype = ctypes.c_void_p
+        lib.tft_aggregator_port.argtypes = [ctypes.c_void_p]
+        lib.tft_aggregator_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tft_aggregator_free.argtypes = [ctypes.c_void_p]
         lib.tft_manager_new.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_char_p),
         ]
+        lib.tft_manager_control_status.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_control_status.restype = ctypes.c_void_p
         lib.tft_manager_address.argtypes = [ctypes.c_void_p]
         lib.tft_manager_address.restype = ctypes.c_void_p
         lib.tft_manager_publish_telemetry.argtypes = [
@@ -310,18 +327,26 @@ class LighthouseServer:
         heartbeat_timeout_ms: int = 5000,
         health: "Optional[dict]" = None,
         history_path: str = "",
+        metrics_per_replica_limit: "Optional[int]" = None,
     ) -> None:
         """``health`` configures the healthwatch ledger (HealthOpts fields,
         see torchft_tpu/healthwatch.py); None reads ``TORCHFT_HEALTH_*``
         from the environment (default: observe mode). ``history_path``
         enables the recorded-history store: append-only JSONL of quorum
         transitions / heals / health events / telemetry snapshots, readable
-        via :func:`history_replay` (empty = disabled)."""
+        via :func:`history_replay` (empty = disabled).
+        ``metrics_per_replica_limit`` caps per-replica /metrics series (the
+        tail collapses into min/median/max aggregates); None reads
+        ``TORCHFT_METRICS_PER_REPLICA_LIMIT`` (default 64)."""
         lib = _load()
         if health is None:
             from torchft_tpu.healthwatch import HealthConfig
 
             health = HealthConfig.from_env().to_json()
+        if metrics_per_replica_limit is None:
+            metrics_per_replica_limit = int(
+                os.environ.get(METRICS_PER_REPLICA_LIMIT_ENV, "") or 64
+            )
         handle = ctypes.c_void_p()
         err = ctypes.c_char_p()
         opts = {
@@ -332,6 +357,7 @@ class LighthouseServer:
             "heartbeat_timeout_ms": heartbeat_timeout_ms,
             "health": health,
             "history_path": history_path,
+            "metrics_per_replica_limit": metrics_per_replica_limit,
         }
         status = lib.tft_lighthouse_new_v2(
             json.dumps(opts).encode(), ctypes.byref(handle), ctypes.byref(err)
@@ -360,6 +386,71 @@ class LighthouseServer:
             pass
 
 
+class AggregatorServer:
+    """Pod-level lighthouse aggregator (native C++, ``native/aggregator.cc``).
+
+    Fronts a pod of replica-group managers: speaks the lighthouse wire
+    protocol downstream (``heartbeat`` / ``quorum`` / ``GET /status``) so a
+    manager points at it via ``TORCHFT_LIGHTHOUSE_AGGREGATOR`` with zero API
+    changes, and batches the pod into one delta-encoded ``agg_tick`` RPC per
+    tick upstream to the root lighthouse.
+    """
+
+    def __init__(
+        self,
+        root_addr: str,
+        bind: str = "0.0.0.0:0",
+        agg_id: str = "",
+        tick_ms: int = 100,
+        heartbeat_timeout_ms: int = 5000,
+        connect_timeout: "float | timedelta" = 10.0,
+    ) -> None:
+        lib = _load()
+        handle = ctypes.c_void_p()
+        err = ctypes.c_char_p()
+        opts = {
+            "bind": bind,
+            "root_addr": root_addr,
+            "agg_id": agg_id,
+            "tick_ms": tick_ms,
+            "heartbeat_timeout_ms": heartbeat_timeout_ms,
+            "connect_timeout_ms": _ms(connect_timeout),
+        }
+        status = lib.tft_aggregator_new(
+            json.dumps(opts).encode(), ctypes.byref(handle), ctypes.byref(err)
+        )
+        _raise_for_status(status, _take_str(lib, err), "aggregator start failed")
+        self._lib = lib
+        self._handle = handle
+
+    def address(self) -> str:
+        return _take_str(self._lib, self._lib.tft_aggregator_address(self._handle))
+
+    def status(self) -> dict:
+        """Pod + upstream view: pod_size/pod_live, joiners_pending,
+        ticks_ok/ticks_failed, upstream_bytes, last_tick_ok, last_error."""
+        return json.loads(
+            _take_str(self._lib, self._lib.tft_aggregator_status(self._handle))
+            or "{}"
+        )
+
+    @property
+    def port(self) -> int:
+        return self._lib.tft_aggregator_port(self._handle)
+
+    def shutdown(self) -> None:
+        if self._handle:
+            self._lib.tft_aggregator_shutdown(self._handle)
+
+    def __del__(self) -> None:
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.tft_aggregator_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
 class ManagerServer:
     """Per-replica-group manager server (native C++).
 
@@ -378,7 +469,13 @@ class ManagerServer:
         heartbeat_interval: "float | timedelta" = 0.1,
         connect_timeout: "float | timedelta" = 10.0,
         quorum_retries: int = 0,
+        aggregator_addr: str = "",
     ) -> None:
+        """``aggregator_addr`` points control RPCs at a pod aggregator
+        (:class:`AggregatorServer`); empty = flat fleet, direct to the
+        lighthouse. The manager fails over to direct-to-root on its own if
+        the aggregator dies and re-points when the root names a
+        replacement."""
         lib = _load()
         handle = ctypes.c_void_p()
         err = ctypes.c_char_p()
@@ -392,6 +489,7 @@ class ManagerServer:
             "heartbeat_interval_ms": _ms(heartbeat_interval),
             "connect_timeout_ms": _ms(connect_timeout),
             "quorum_retries": quorum_retries,
+            "aggregator_addr": aggregator_addr,
         }
         status = lib.tft_manager_new(
             json.dumps(opts).encode(), ctypes.byref(handle), ctypes.byref(err)
@@ -436,6 +534,17 @@ class ManagerServer:
         return json.loads(
             _take_str(
                 self._lib, self._lib.tft_manager_clock_skew(self._handle)
+            )
+            or "{}"
+        )
+
+    def control_status(self) -> dict:
+        """Two-level control plane view: ``aggregator_addr`` /
+        ``via_aggregator`` / ``direct_mode`` / ``failovers`` — which
+        upstream the heartbeat/quorum RPCs currently use."""
+        return json.loads(
+            _take_str(
+                self._lib, self._lib.tft_manager_control_status(self._handle)
             )
             or "{}"
         )
@@ -513,6 +622,13 @@ def set_rpc_fault_hook(
 # map to RuntimeError, stalls to TimeoutError). _NOT_FOUND/_INVALID are
 # semantic errors — retrying cannot change the answer.
 _RETRYABLE_RPC_ERRORS = (TimeoutError, RuntimeError, ConnectionError)
+
+# Connection-loss classes retry with FULL jitter (uniform [0, ceiling]): a
+# restarted lighthouse drops every replica at the same instant, and bounded
+# jitter would wake the whole herd inside the top half of each backoff
+# window (retry.RetryPolicy.backoff_s). Timeouts keep bounded jitter — they
+# are not herd-synchronized and bounded jitter preserves deadline pacing.
+_FULL_JITTER_RPC_ERRORS = (ConnectionError, RuntimeError)
 
 
 def _seconds(timeout: "float | timedelta") -> float:
@@ -612,6 +728,7 @@ class _RawClient:
                 policy,
                 timeout=_seconds(timeout),
                 retryable=_RETRYABLE_RPC_ERRORS,
+                full_jitter_on=_FULL_JITTER_RPC_ERRORS,
                 on_attempt=_on_attempt,
             )
         except RetryBudgetExhausted as e:

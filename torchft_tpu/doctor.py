@@ -451,11 +451,87 @@ def check_metrics_endpoints() -> Result:
         return False, f"/metrics probe failed: {e}"
 
 
+def check_aggregator() -> Result:
+    """Two-level control plane: validate the TORCHFT_LIGHTHOUSE_AGGREGATOR
+    wiring, then prove the aggregator path works end to end on loopback —
+    a beat sent to an AggregatorServer must surface at the root lighthouse
+    via a batched agg_tick (not a direct heartbeat)."""
+    import time as _time
+
+    try:
+        from torchft_tpu.coordination import (
+            AggregatorServer,
+            LighthouseClient,
+            LighthouseServer,
+        )
+        from torchft_tpu.manager import AGGREGATOR_ENV, LIGHTHOUSE_ENV
+
+        env_note = "flat fleet (no aggregator env)"
+        agg_env = os.environ.get(AGGREGATOR_ENV, "")
+        if agg_env:
+            host, sep, port = agg_env.replace("http://", "").rpartition(":")
+            if not sep or not host or not port.isdigit():
+                return False, (
+                    f"{AGGREGATOR_ENV}={agg_env!r} is not host:port — "
+                    "managers will fail to start"
+                )
+            if not os.environ.get(LIGHTHOUSE_ENV, ""):
+                return False, (
+                    f"{AGGREGATOR_ENV} is set but {LIGHTHOUSE_ENV} is not: "
+                    "the pod cannot fail over to the root if its "
+                    "aggregator dies — set both"
+                )
+            env_note = f"two-level ({agg_env} -> {os.environ[LIGHTHOUSE_ENV]})"
+
+        root = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=500,
+            quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        )
+        agg = None
+        try:
+            agg = AggregatorServer(
+                root_addr=f"127.0.0.1:{root.port}", bind="127.0.0.1:0",
+                agg_id="doctor_pod", tick_ms=50,
+            )
+            pod_client = LighthouseClient(
+                f"127.0.0.1:{agg.port}", connect_timeout=5.0
+            )
+            resp = pod_client.heartbeat("doctor", timeout=5.0)
+            if not resp.get("aggregated"):
+                return False, "aggregator heartbeat response not marked aggregated"
+            root_client = LighthouseClient(
+                f"127.0.0.1:{root.port}", connect_timeout=5.0
+            )
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline:
+                st = root_client.status(timeout=5.0)
+                if "doctor" in st.get("heartbeat_ages_ms", {}):
+                    if st.get("rx", {}).get("heartbeat", {}).get("calls", 0):
+                        return False, (
+                            "beat reached the root as a DIRECT heartbeat — "
+                            "the aggregator forwarded instead of batching"
+                        )
+                    ticks = st["aggregators"]["doctor_pod"]["ticks"]
+                    return True, (
+                        f"{env_note}; loopback pod beat surfaced at root "
+                        f"via agg_tick (ticks={ticks})"
+                    )
+                _time.sleep(0.1)
+            return False, "pod beat never surfaced at the root within 10s"
+        finally:
+            if agg is not None:
+                agg.shutdown()
+            root.shutdown()
+    except Exception as e:  # noqa: BLE001
+        return False, f"aggregator probe failed: {e}"
+
+
 CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("native", check_native),
     ("accelerator", check_accelerator),
     ("virtual-mesh", check_virtual_mesh),
     ("lighthouse", check_lighthouse_roundtrip),
+    ("aggregator", check_aggregator),
     ("retry-env", check_retry_env),
     ("health-env", check_health_env),
     ("compress-env", check_compress_env),

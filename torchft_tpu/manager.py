@@ -88,6 +88,10 @@ __all__ = ["Manager", "WorldSizeMode", "ExceptionWithTraceback"]
 # env-var config knobs (reference: manager.py:74-89)
 MANAGER_PORT_ENV = "TORCHFT_MANAGER_PORT"
 LIGHTHOUSE_ENV = "TORCHFT_LIGHTHOUSE"
+# optional pod-level lighthouse aggregator (two-level control plane); the
+# manager prefers it for heartbeat/quorum and fails over to the root
+# lighthouse on its own if it dies (coordination.AggregatorServer)
+AGGREGATOR_ENV = "TORCHFT_LIGHTHOUSE_AGGREGATOR"
 TIMEOUT_SEC_ENV = "TORCHFT_TIMEOUT_SEC"
 QUORUM_TIMEOUT_SEC_ENV = "TORCHFT_QUORUM_TIMEOUT_SEC"
 CONNECT_TIMEOUT_SEC_ENV = "TORCHFT_CONNECT_TIMEOUT_SEC"
@@ -308,6 +312,7 @@ class Manager:
                 heartbeat_interval=heartbeat_interval,
                 connect_timeout=self._connect_timeout,
                 quorum_retries=quorum_retries,
+                aggregator_addr=os.environ.get(AGGREGATOR_ENV, ""),
             )
             self._replica_id = full_replica_id
             manager_addr = self._manager.address()
@@ -2117,6 +2122,19 @@ class Manager:
         incomplete, warned once per Manager."""
         with self._metrics_lock:
             out = dict(self._timings)
+        # Two-level control plane: when this replica is configured for a
+        # lighthouse aggregator (TORCHFT_LIGHTHOUSE_AGGREGATOR), mirror
+        # which upstream the control RPCs use (``via_aggregator``) and the
+        # cumulative aggregator->root ``aggregator_failovers``.
+        cs_fn = getattr(getattr(self, "_manager", None), "control_status", None)
+        if cs_fn is not None:
+            try:
+                cs = cs_fn() or {}
+                if cs.get("aggregator_addr"):
+                    out["via_aggregator"] = 1.0 if cs.get("via_aggregator") else 0.0
+                    out["aggregator_failovers"] = float(cs.get("failovers", 0))
+            except Exception:  # noqa: BLE001 — advisory plane
+                pass
         out["dropped_events"] = float(get_event_drain().dropped)
         out["trace_dropped"] = self._tracer.stats()["dropped"]
         if (

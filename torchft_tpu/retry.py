@@ -84,13 +84,30 @@ class RetryPolicy:
     def enabled(self) -> bool:
         return self.max_attempts > 1
 
-    def backoff_s(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+    def backoff_s(
+        self,
+        attempt: int,
+        rng: Optional[random.Random] = None,
+        full: bool = False,
+    ) -> float:
         """Sleep before attempt ``attempt`` (attempts are 1-based; the first
-        retry — attempt 2 — backs off ``~base_s``)."""
+        retry — attempt 2 — backs off ``~base_s``).
+
+        ``full=True`` switches to FULL jitter — uniform in ``[0, ceiling]``
+        (AWS-style) instead of the bounded ``[ceiling*(1-jitter), ceiling]``
+        band. Used for reconnect-after-connection-loss: when a restarted
+        server drops every client at the same instant, their retry clocks
+        are perfectly synchronized, and the bounded band (at the default
+        jitter=0.5 it never sleeps below half the ceiling) re-packs the
+        herd into the top half of every backoff window. Full jitter spreads
+        reconnects across the whole window, so the server sees a trickle
+        instead of a stampede."""
         if attempt <= 1:
             return 0.0
         ceiling = min(self.base_s * (2.0 ** (attempt - 2)), self.max_backoff_s)
         draw = (rng or random).random()
+        if full:
+            return ceiling * draw
         return ceiling * (1.0 - self.jitter * draw)
 
     @classmethod
@@ -129,6 +146,7 @@ def retry_call(
     *,
     timeout: float,
     retryable: Tuple[Type[BaseException], ...] = (Exception,),
+    full_jitter_on: Tuple[Type[BaseException], ...] = (),
     on_attempt: Optional[Callable[[int, Optional[BaseException]], None]] = None,
     rng: Optional[random.Random] = None,
     clock: Callable[[], float] = time.monotonic,
@@ -141,11 +159,15 @@ def retry_call(
     never overshot. ``on_attempt(attempt, prior_exception)`` fires before
     every attempt (prior_exception is None on the first), letting callers
     count retries without owning the loop. Non-``retryable`` exceptions
-    propagate immediately. When the budget or attempts run out,
-    :class:`RetryBudgetExhausted` is raised from the last failure — except in
-    the single-attempt case, where the original exception propagates
-    unchanged (zero-retry config must be bit-compatible with no retry layer
-    at all).
+    propagate immediately. ``full_jitter_on`` selects exception classes
+    whose retries back off with FULL jitter (uniform ``[0, ceiling]``) —
+    connection-loss classes, where a server restart synchronizes every
+    client's retry clock and the default bounded jitter would re-pack the
+    reconnect herd (see :meth:`RetryPolicy.backoff_s`). When the budget or
+    attempts run out, :class:`RetryBudgetExhausted` is raised from the last
+    failure — except in the single-attempt case, where the original
+    exception propagates unchanged (zero-retry config must be bit-compatible
+    with no retry layer at all).
     """
     if policy is None:
         policy = RetryPolicy.from_env()
@@ -155,7 +177,8 @@ def retry_call(
     while attempt < policy.max_attempts:
         attempt += 1
         if attempt > 1:
-            pause = policy.backoff_s(attempt, rng)
+            full = bool(full_jitter_on) and isinstance(last_exc, full_jitter_on)
+            pause = policy.backoff_s(attempt, rng, full=full)
             remaining = deadline - clock()
             if remaining <= 0:
                 break

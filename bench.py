@@ -700,6 +700,86 @@ def fleet(smoke: bool = False) -> None:
     }))
 
 
+def serving_metrics(smoke: bool = False) -> dict:
+    """Run benchmarks/serving_bench.py in a subprocess (it stands up a
+    registry + publishers + workers, dozens of loopback sockets and
+    threads — own process keeps the blast radius away from the harness)
+    and parse its one-line JSON summary."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "serving_bench.py",
+    )
+    cmd = [sys.executable, script] + (["--smoke"] if smoke else [])
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True,
+        timeout=300 if smoke else 1800,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serving bench failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip().splitlines()[-8:]}"
+        )
+    last = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")][-1]
+    return _json.loads(last)
+
+
+def serving(smoke: bool = False) -> None:
+    """``python bench.py --serving [--smoke]``: one JSON line with the
+    serving-plane load summary. The gates hold the plane's three promises
+    (docs/serving.md): a replica kill + quorum reconfigure mid-traffic
+    fails ZERO requests, every worker's final params are bitwise-equal to
+    the fleet's published snapshot, and per-step delta pulls move >= 3x
+    fewer bytes than full pulls at fp8. Full runs also write
+    BENCH_SERVE.json."""
+    metrics = serving_metrics(smoke=smoke)
+    required = [
+        "serving_failed_requests",
+        "serving_bitwise_equal",
+        "serving_converged",
+        "serving_delta_savings_x",
+        "serving_p99_ms",
+    ]
+    missing = [k for k in required if metrics.get(k) is None]
+    if missing:
+        raise RuntimeError(f"serving: missing keys: {missing}")
+    if metrics["serving_failed_requests"] != 0:
+        raise RuntimeError(
+            f"serving: {metrics['serving_failed_requests']} request(s) "
+            "failed through the chaos turn — the request plane must answer "
+            "from the last-applied version no matter what the fleet does"
+        )
+    if not metrics["serving_converged"]:
+        raise RuntimeError(
+            "serving: workers never converged to the fleet's final "
+            "snapshot version after the kill"
+        )
+    if not metrics["serving_bitwise_equal"]:
+        raise RuntimeError(
+            "serving: a worker's final params diverged from the published "
+            "snapshot — the delta/full bitwise invariant broke"
+        )
+    if not metrics["serving_delta_savings_x"] >= 3.0:
+        raise RuntimeError(
+            f"serving: delta pulls move only "
+            f"{metrics['serving_delta_savings_x']:.2f}x fewer bytes than "
+            "full pulls (gate: 3x at fp8) — the compressed delta wire "
+            "regressed"
+        )
+    print(json.dumps({
+        "metric": "serving delta-pull byte savings (full / delta)",
+        "value": metrics["serving_delta_savings_x"],
+        "unit": "x",
+        "vs_baseline": metrics["serving_delta_savings_x"],
+        **metrics,
+    }))
+
+
 def main() -> None:
     # shared fallback policy (ensure_responsive_backend): one probe, one
     # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
@@ -980,6 +1060,10 @@ if __name__ == "__main__":
     if "--fleet" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         fleet(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--serving" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        serving(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--smoke" in sys.argv[1:]:
         # no always-emit wrapper here: the smoke gate must fail loudly

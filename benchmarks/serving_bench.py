@@ -1,0 +1,356 @@
+"""Serving-plane load harness: loopback workers under live traffic.
+
+Stands up a snapshot registry, P publishers (the "training fleet": a
+driver thread commits a new version every publish interval, all live
+publishers publish the SAME committed params — the lockstep the quorum
+protocol guarantees), and a grid of worker counts answering real HTTP
+``/infer`` traffic on loopback:
+
+    python benchmarks/serving_bench.py           # full grid + BENCH_SERVE.json
+    python benchmarks/serving_bench.py --smoke   # tier-1 gate: 1 point
+
+Phases per worker count: warm (every worker reaches the first version),
+load (closed-loop request threads, latency histogram + lag sampling).
+At the largest worker count the load phase takes a CHAOS turn: mid-
+traffic, publisher 0 is killed outright and its health flips to ``warn``
+— the registry must drain it, workers must fail over their pulls, and
+(the headline gate) **zero requests may fail**; a quorum "reconfigure"
+(quorum_id bump) also lands mid-load to prove version monotonicity under
+traffic.  The run ends by checking every worker's final parameters are
+bitwise-equal to the surviving fleet's published snapshot.
+
+Numbers are loopback-on-shared-vCPUs: requests/s measures the plane's
+bookkeeping cost, not network serving capacity — see the provenance
+block in BENCH_SERVE.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torchft_tpu.serving import (  # noqa: E402
+    ServeConfig,
+    ServeWorker,
+    SnapshotPublisher,
+    SnapshotRegistry,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Fleet:
+    """Driver for P lockstep publishers + a mutable health view."""
+
+    def __init__(self, cfg: ServeConfig, n_publishers: int, n_params: int,
+                 publish_interval_s: float) -> None:
+        self.cfg = cfg
+        self.health = {"replicas": {}}  # mutated by the chaos turn
+        self._health_lock = threading.Lock()
+        self.registry = SnapshotRegistry(
+            health_fn=self._health_view, drain_on=cfg.drain_on, poll_s=0.05
+        )
+        cfg.registry = self.registry.url
+        self.publishers = []
+        for i in range(n_publishers):
+            rid = f"serve_replica_{i}"
+            self.publishers.append(
+                SnapshotPublisher(rid, config=cfg, registry_url=self.registry.url)
+            )
+            with self._health_lock:
+                self.health["replicas"][rid] = {"state": "ok"}
+        self.rng = np.random.RandomState(1234)
+        self.params = {"w": self.rng.randn(n_params).astype(np.float32)}
+        self.quorum_id = 1
+        self.step = 0
+        self.dead: set = set()
+        self.publish_interval_s = publish_interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _health_view(self) -> dict:
+        with self._health_lock:
+            return json.loads(json.dumps(self.health))
+
+    def set_state(self, i: int, state: str) -> None:
+        with self._health_lock:
+            self.health["replicas"][f"serve_replica_{i}"] = {"state": state}
+
+    def start(self) -> None:
+        self.commit_once()  # version 0 exists before any worker starts
+        self._thread.start()
+
+    def commit_once(self) -> None:
+        # one committed training step: identical params reach every live
+        # replica's publisher (what the commit path guarantees)
+        self.params["w"] = (
+            self.params["w"]
+            + self.rng.randn(self.params["w"].size).astype(np.float32) * 0.01
+        )
+        for i, pub in enumerate(self.publishers):
+            if i not in self.dead:
+                pub.publish(self.quorum_id, self.step, self.params)
+        self.step += 1
+
+    def kill(self, i: int) -> None:
+        """Abrupt publisher death + the health ledger noticing (warn)."""
+        self.dead.add(i)
+        self.publishers[i].kill()
+        self.set_state(i, "warn")
+
+    def reconfigure(self) -> None:
+        self.quorum_id += 1
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.publish_interval_s):
+            self.commit_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def shutdown(self) -> None:
+        self.stop()
+        for i, pub in enumerate(self.publishers):
+            if i not in self.dead:
+                pub.shutdown()
+        self.registry.shutdown()
+
+    def survivor_flat(self) -> np.ndarray:
+        for i, pub in enumerate(self.publishers):
+            if i not in self.dead:
+                flat = pub.ref_flat()
+                if flat is not None:
+                    return flat
+        raise RuntimeError("no surviving publisher")
+
+    def latest_version(self):
+        best = None
+        for i, pub in enumerate(self.publishers):
+            if i not in self.dead and pub.version is not None:
+                if best is None or pub.version > best:
+                    best = pub.version
+        return best
+
+
+class _LoadGen:
+    """Closed-loop HTTP request threads against a set of workers."""
+
+    def __init__(self, worker_urls, n_threads: int, timeout_s: float = 5.0):
+        self.urls = list(worker_urls)
+        self.n_threads = n_threads
+        self.timeout_s = timeout_s
+        self.latencies_ms = []
+        self.failures = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+
+    def _run(self, tid: int) -> None:
+        i = 0
+        while not self._stop.is_set():
+            url = self.urls[(tid + i) % len(self.urls)]
+            seed = tid * 1_000_003 + i
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/infer?seed={seed}", timeout=self.timeout_s
+                ) as r:
+                    body = json.loads(r.read().decode())
+                    ok = r.status == 200 and body.get("result") is not None
+                err = None if ok else f"bad body: {body}"
+            except Exception as e:  # noqa: BLE001 — a failure IS the metric
+                err = repr(e)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                if err is None:
+                    self.latencies_ms.append(dt_ms)
+                else:
+                    self.failures.append(err)
+            i += 1
+
+    def start(self) -> None:
+        for t in range(self.n_threads):
+            th = threading.Thread(target=self._run, args=(t,), daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=self.timeout_s + 1)
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+def run_point(fleet: _Fleet, n_workers: int, load_s: float, chaos: bool,
+              cfg: ServeConfig) -> dict:
+    workers = [
+        ServeWorker(fleet.registry.url, config=cfg, name=f"w{n_workers}_{i}")
+        for i in range(n_workers)
+    ]
+    try:
+        warm_deadline = time.monotonic() + 30.0
+        for w in workers:
+            if not w.wait_version((fleet.quorum_id, 0), timeout=max(
+                0.1, warm_deadline - time.monotonic()
+            )):
+                raise RuntimeError(f"worker {w.name} never warmed")
+
+        gen = _LoadGen([w.url for w in workers], n_threads=max(2, n_workers))
+        lags = []
+        gen.start()
+        t0 = time.monotonic()
+        killed = reconfigured = False
+        while time.monotonic() - t0 < load_s:
+            time.sleep(0.05)
+            for w in workers:
+                lags.append(w.status()["lag_steps"])
+            elapsed = time.monotonic() - t0
+            if chaos and not reconfigured and elapsed > load_s * 0.25:
+                fleet.reconfigure()  # quorum change mid-traffic
+                reconfigured = True
+            if chaos and not killed and elapsed > load_s * 0.5:
+                fleet.kill(0)  # replica death mid-traffic
+                killed = True
+        gen.stop()
+        wall_s = time.monotonic() - t0
+
+        # quiesce: stop publishing, let every worker converge to the tip
+        fleet.stop()
+        final_version = fleet.latest_version()
+        converged = all(
+            w.wait_version(final_version, timeout=20.0) for w in workers
+        )
+        survivor = fleet.survivor_flat()
+        bitwise = converged and all(
+            np.array_equal(w.params_flat(), survivor) for w in workers
+        )
+
+        counters = {k: 0 for k in workers[0].counters}
+        for w in workers:
+            for k, v in w.counters.items():
+                counters[k] += v
+        n_ok = len(gen.latencies_ms)
+        return {
+            "workers": n_workers,
+            "chaos": chaos,
+            "requests_ok": n_ok,
+            "requests_failed": len(gen.failures),
+            "failure_samples": gen.failures[:5],
+            "rps": n_ok / wall_s if wall_s > 0 else 0.0,
+            "p50_ms": _percentile(gen.latencies_ms, 50),
+            "p99_ms": _percentile(gen.latencies_ms, 99),
+            "lag_p50_steps": _percentile(lags, 50),
+            "lag_p99_steps": _percentile(lags, 99),
+            "converged": bool(converged),
+            "bitwise_equal": bool(bitwise),
+            "final_version": list(final_version) if final_version else None,
+            "counters": counters,
+        }
+    finally:
+        for w in workers:
+            w.shutdown()
+
+
+def run(smoke: bool) -> dict:
+    n_params = 65_536 if smoke else 524_288
+    worker_grid = [2] if smoke else [1, 2, 4]
+    load_s = 3.0 if smoke else 8.0
+    cfg = ServeConfig(
+        registry="", max_lag=8, compress="fp8",
+        poll_s=0.02, drain_on="warn", timeout_s=15.0,
+    )
+    points = []
+    delta_per_version = full_per_pull = 0.0
+    for idx, n_workers in enumerate(worker_grid):
+        chaos = idx == len(worker_grid) - 1  # chaos turn at the largest point
+        fleet = _Fleet(
+            cfg, n_publishers=2 if smoke else 3, n_params=n_params,
+            publish_interval_s=0.10 if smoke else 0.08,
+        )
+        try:
+            fleet.start()
+            point = run_point(fleet, n_workers, load_s, chaos, cfg)
+            points.append(point)
+            c = point["counters"]
+            if c["delta_pulls_total"]:
+                delta_per_version = c["delta_bytes_total"] / c["delta_pulls_total"]
+            if c["full_pulls_total"]:
+                full_per_pull = c["full_bytes_total"] / c["full_pulls_total"]
+        finally:
+            fleet.shutdown()
+
+    chaos_point = points[-1]
+    savings = (full_per_pull / delta_per_version) if delta_per_version else 0.0
+    metrics = {
+        "serving_points": points,
+        "serving_rps_by_workers": {
+            str(p["workers"]): round(p["rps"], 1) for p in points
+        },
+        "serving_p50_ms": chaos_point["p50_ms"],
+        "serving_p99_ms": chaos_point["p99_ms"],
+        "serving_lag_p50_steps": chaos_point["lag_p50_steps"],
+        "serving_lag_p99_steps": chaos_point["lag_p99_steps"],
+        "serving_failed_requests": sum(p["requests_failed"] for p in points),
+        "serving_requests_ok": sum(p["requests_ok"] for p in points),
+        "serving_converged": all(p["converged"] for p in points),
+        "serving_bitwise_equal": all(p["bitwise_equal"] for p in points),
+        "serving_delta_bytes_per_version": round(delta_per_version, 1),
+        "serving_full_bytes_per_pull": round(full_per_pull, 1),
+        "serving_delta_savings_x": round(savings, 2),
+        "serving_n_params": n_params,
+        "serving_compress": cfg.compress,
+    }
+    return metrics
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true")
+    args = parser.parse_args()
+
+    metrics = run(smoke=args.smoke)
+
+    if not args.smoke:
+        artifact = {
+            "provenance": {
+                "harness": "benchmarks/serving_bench.py (loopback)",
+                "caveats": [
+                    "loopback HTTP on shared vCPUs: rps/latency measure the "
+                    "serving plane's bookkeeping cost, not network capacity",
+                    "publishers are driven in lockstep by one thread (the "
+                    "commit-path guarantee), not by live training",
+                    "requests/s is closed-loop with 2x-workers client "
+                    "threads; p99 includes client-side connection setup",
+                ],
+                "host": os.uname().nodename,
+                "cpu_count": os.cpu_count(),
+            },
+            "metrics": {
+                k: v for k, v in metrics.items() if k != "serving_points"
+            },
+            "points": metrics["serving_points"],
+        }
+        out = os.path.join(REPO_ROOT, "BENCH_SERVE.json")
+        with open(out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
+
+    print(json.dumps(metrics))
+
+
+if __name__ == "__main__":
+    main()

@@ -138,3 +138,18 @@ def test_bench_fleet_smoke_holds_fanin_and_convergence():
     assert rec["fleet_two_level_convergence_ms_at_max"] > 0
     assert rec["fleet_flat_fanin_bytes_per_tick_at_max"] > 0
     assert rec["fleet_two_level_fanin_bytes_per_tick_at_max"] > 0
+
+
+def test_bench_serving_smoke_sustains_traffic_through_kill():
+    rec = _run_bench("--serving", "--smoke")
+    # the smoke run itself gates these (zero failed requests through the
+    # mid-traffic kill, bitwise convergence, delta savings); re-check the
+    # load-bearing ones here so a silently-weakened serving() still fails
+    assert rec["serving_failed_requests"] == 0
+    assert rec["serving_requests_ok"] > 0
+    assert rec["serving_converged"] is True
+    assert rec["serving_bitwise_equal"] is True
+    assert rec["serving_delta_savings_x"] > 1.0
+    assert rec["serving_p99_ms"] > 0
+    assert all(v > 0 for v in rec["serving_rps_by_workers"].values())
+    assert rec["serving_lag_p99_steps"] >= 0

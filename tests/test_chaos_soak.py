@@ -854,3 +854,177 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
     assert np.isfinite(finals[0]).all(), label
     assert fleet_max_step[0] >= target, (label, fleet_max_step[0])
     assert heal_count[0] >= 1, f"{label}: chaos never produced a live heal"
+
+
+@pytest.mark.slow
+def test_serving_kill_mid_traffic_drains_and_converges():
+    """Serving-plane chaos phase: live traffic runs against two workers
+    while the fleet publishes a snapshot every ~50 ms; the injector kills
+    the replica that announces version (1, KILL_STEP) — its full-pull and
+    delta endpoints vanish the instant the hottest version exists — and
+    scripted health then reports it ``warn`` so the registry drains it
+    from rotation (serving reacts at WARN, before training would eject).
+    The bar: ZERO failed requests end to end (the request plane answers
+    from the last-applied snapshot under a local lock), every worker
+    fails over mid-pull (failover counters tick), and once publishing
+    stops all workers converge to the SAME final version with params
+    bitwise-equal to the surviving publisher's reference."""
+    import urllib.request
+
+    from torchft_tpu._test.event_injector import EventInjector
+    from torchft_tpu.serving import (
+        ServeConfig,
+        ServeWorker,
+        SnapshotPublisher,
+        SnapshotRegistry,
+    )
+
+    kill_step = 6
+    final_step = 12
+    n_workers = 2
+
+    injector = EventInjector()
+    health_states = {"serve_r0": "ok", "serve_r1": "ok"}
+    health_lock = threading.Lock()
+
+    def health_fn():
+        with health_lock:
+            return {
+                "replicas": {
+                    r: {"state": s} for r, s in health_states.items()
+                },
+                "excluded": [],
+            }
+
+    reg = SnapshotRegistry(health_fn=health_fn, drain_on="warn", poll_s=0.02)
+    cfg = ServeConfig(
+        registry=reg.url, max_lag=8, compress="fp8", poll_s=0.02,
+        drain_on="warn", timeout_s=5.0,
+    )
+    pubs = [
+        SnapshotPublisher(f"serve_r{i}", config=cfg, registry_url=reg.url)
+        for i in range(2)
+    ]
+    workers = [
+        ServeWorker(reg.url, config=cfg, name=f"soak_w{i}")
+        for i in range(n_workers)
+    ]
+
+    stop_traffic = threading.Event()
+    failures: list = []
+    ok_requests = [0]
+    req_lock = threading.Lock()
+
+    def loadgen(url: str) -> None:
+        seed = 0
+        while not stop_traffic.is_set():
+            seed += 1
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/infer?seed={seed}", timeout=5.0
+                ) as r:
+                    if r.status != 200:
+                        failures.append(("status", r.status))
+                        continue
+                    body = r.read()
+                    import json as _json
+
+                    resp = _json.loads(body.decode())
+                    if resp.get("result") is None:
+                        failures.append(("empty", resp))
+                        continue
+                with req_lock:
+                    ok_requests[0] += 1
+            except Exception as e:  # noqa: BLE001 — any error is a failure
+                failures.append(("exc", repr(e)))
+            time.sleep(0.002)
+
+    rng = np.random.RandomState(0x5E12)
+    params = {"w": rng.randn(4096).astype(np.float32)}
+
+    def publish_all(step: int) -> None:
+        for pub in pubs:
+            if not pub._killed:
+                pub.publish(1, step, params)
+
+    traffic = ThreadPoolExecutor(max_workers=n_workers)
+    try:
+        # seed the chain and let every worker land on v0 BEFORE traffic
+        # starts, so an empty result can only mean a real regression
+        publish_all(0)
+        for w in workers:
+            assert w.wait_version((1, 0), timeout=10.0), w.status()
+        futs = [traffic.submit(loadgen, w.url) for w in workers]
+
+        injector.kill_snapshot_source((1, kill_step))
+        injector.delay_worker_pull(0.03, times=5)  # congested pull plane
+
+        for step in range(1, kill_step + 1):
+            params["w"] = (params["w"] * 0.999 + 0.01 * step).astype(
+                np.float32
+            )
+            publish_all(step)
+            time.sleep(0.05)
+
+        # the announcer of (1, kill_step) is dead; every worker must walk
+        # through that version with the dead source at the head of the
+        # listing (newest-first, replica-id tiebreak) -> guaranteed
+        # mid-pull failover before the registry drains it
+        dead = [p for p in pubs if p._killed]
+        assert len(dead) == 1, "kill_snapshot_source must fire exactly once"
+        dead_id = dead[0].replica_id
+        for w in workers:
+            assert w.wait_version((1, kill_step), timeout=15.0), w.status()
+
+        # healthwatch notices: the dead replica reports warn; the registry
+        # poll folds it into the drain set (drain-before-eject policy)
+        with health_lock:
+            health_states[dead_id] = "warn"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if dead_id in reg.sources()["draining"]:
+                break
+            time.sleep(0.02)
+        assert dead_id in reg.sources()["draining"], reg.sources()
+
+        # traffic keeps flowing while the survivor publishes on
+        for step in range(kill_step + 1, final_step + 1):
+            params["w"] = (params["w"] * 0.999 + 0.01 * step).astype(
+                np.float32
+            )
+            publish_all(step)
+            time.sleep(0.05)
+
+        survivor = next(p for p in pubs if not p._killed)
+        final_version = survivor.version
+        assert final_version == (1, final_step)
+        for w in workers:
+            assert w.wait_version(final_version, timeout=20.0), w.status()
+
+        # one more settling beat of traffic against the converged fleet
+        time.sleep(0.2)
+    finally:
+        stop_traffic.set()
+        traffic.shutdown(wait=True)
+        injector.clear_serve_faults()
+        for w in workers:
+            w.shutdown()
+        for p in pubs:
+            p.shutdown()
+        reg.shutdown()
+
+    assert not failures, (
+        f"{len(failures)} failed requests (first: {failures[:3]}); "
+        f"{ok_requests[0]} succeeded"
+    )
+    assert ok_requests[0] > 50, ok_requests[0]
+    assert injector.count >= 2, injector.count  # kill + pull delays fired
+    ref = survivor.ref_flat()
+    versions = {tuple(w.version) for w in workers}
+    assert versions == {final_version}, versions
+    for w in workers:
+        np.testing.assert_array_equal(
+            w.params_flat(), ref,
+            err_msg=f"{w.name} diverged from the surviving publisher",
+        )
+        assert w.counters["pull_failovers_total"] >= 1, w.counters

@@ -66,3 +66,113 @@ def test_sessions_are_isolated(ps):
         np.testing.assert_array_equal(r, np.ones(8))
     finally:
         pg2.shutdown()
+
+
+def test_new_session_retries_until_server_up():
+    """The handshake rides the standard retry layer: a client that calls
+    new_session BEFORE the server exists keeps backing off (connection
+    refused is retryable) and succeeds once the server binds — no caller-
+    side sleep loops."""
+    import socket
+    import threading
+
+    from torchft_tpu.retry import RetryPolicy
+
+    # reserve a port so the late server lands where the client is knocking
+    probe = socket.socket()
+    probe.bind(("0.0.0.0", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    result: dict = {}
+
+    def client() -> None:
+        pg = ParameterServer.new_session(
+            f"http://{socket.gethostname()}:{port}",
+            timeout=30.0,
+            retry_policy=RetryPolicy(
+                max_attempts=40, base_s=0.05, max_backoff_s=0.2
+            ),
+        )
+        try:
+            (got,) = pg.broadcast([np.zeros(8)], root=0).get_future().wait()
+            result["got"] = got
+        finally:
+            pg.shutdown()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    time.sleep(0.4)  # the client is already retrying against a dead port
+    server = _EchoPS(np.arange(8.0), port=port)
+    try:
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "client never completed after server came up"
+        np.testing.assert_array_equal(result["got"], np.arange(8.0))
+    finally:
+        server.shutdown()
+
+
+def test_new_session_times_out_against_dead_address():
+    """With no server ever, the retry budget is a hard wall clock: the
+    call fails within ~timeout instead of hanging."""
+    import socket
+
+    from torchft_tpu.retry import RetryPolicy
+
+    probe = socket.socket()
+    probe.bind(("0.0.0.0", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        ParameterServer.new_session(
+            f"http://{socket.gethostname()}:{port}",
+            timeout=1.0,
+            retry_policy=RetryPolicy(
+                max_attempts=50, base_s=0.05, max_backoff_s=0.2
+            ),
+        )
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_hung_session_setup_is_bounded_and_isolated():
+    """A client that completes the HTTP handshake but never configures its
+    PG must not wedge the hijacked handler thread forever: the setup
+    watchdog aborts the PG at ps._timeout, active_sessions() returns to
+    zero, and a well-behaved session afterwards works untouched."""
+    import urllib.request
+
+    server = _EchoPS(np.arange(8.0), timeout=2.0)
+    try:
+        # half-open session: handshake only, then abandon
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"{server.address()}/new_session", method="POST"
+            ),
+            timeout=5.0,
+        ) as resp:
+            info = resp.read()
+        assert info
+        deadline = time.monotonic() + 1.0
+        while server.active_sessions() < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.active_sessions() >= 1
+
+        # the watchdog fires at ps._timeout and frees the thread
+        deadline = time.monotonic() + 10.0
+        while server.active_sessions() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.active_sessions() == 0, (
+            "hijacked handler thread still wedged after the setup watchdog"
+        )
+
+        # collateral check: a real session on the same server still works
+        pg = ParameterServer.new_session(server.address(), timeout=30.0)
+        try:
+            (got,) = pg.broadcast([np.zeros(8)], root=0).get_future().wait()
+            np.testing.assert_array_equal(got, np.arange(8.0))
+        finally:
+            pg.shutdown()
+    finally:
+        server.shutdown()

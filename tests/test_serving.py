@@ -1,0 +1,658 @@
+"""Serving plane: versioned snapshot registry, publishers, and workers.
+
+Layers, matching torchft_tpu/serving.py (the canonical spec):
+
+- config: ``ServeConfig.from_env`` parsing and validation against the
+  ``TORCHFT_SERVE_*`` contract;
+- registry protocol: (epoch, seq) staleness, strict per-replica version
+  monotonicity across a quorum reconfigure, drain ordering in the
+  source listing, stale-registry rejection after a restart (the PR 8
+  agg_tick pattern applied to serving);
+- drain-before-eject: a scripted healthwatch ``warn``→``eject``
+  escalation must pull a replica out of the serving rotation at WARN —
+  strictly before training-side ejection — under ``drain_on="warn"``;
+- wire equivalence: a delta-walking worker and a full-pulling worker
+  land on bitwise-identical parameters in every compress mode,
+  including ``off`` (the error-feedback reference replay invariant);
+- failover matrix: workers survive sources that are dead at connect or
+  die mid-serve, on both the full-pull and delta paths;
+- lag fallback: a worker > max_lag versions behind takes a ranged full
+  pull instead of walking deltas.
+
+Everything runs on loopback HTTP with tiny parameter vectors; no test
+here should take more than a few seconds.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pytest
+
+from torchft_tpu.healthwatch import HealthConfig, HealthLedger, serving_eligible
+from torchft_tpu.serving import (
+    RegistryClient,
+    ServeConfig,
+    ServeWorker,
+    SnapshotPublisher,
+    SnapshotRegistry,
+    decode_delta,
+    encode_delta,
+    flatten_params,
+    set_serve_fault_hook,
+)
+
+Version = Tuple[int, int]
+
+
+def _cfg(registry: str = "", **kw) -> ServeConfig:
+    base = dict(
+        registry=registry, max_lag=8, compress="fp8",
+        poll_s=0.02, timeout_s=5.0,
+    )
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _params(n: int = 1024, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    return {"w": rng.randn(n).astype(np.float32)}
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_hook():
+    yield
+    set_serve_fault_hook(None)
+
+
+# ---------------------------------------------------------------- config
+class TestServeConfig:
+    def test_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_SERVE_MAX_LAG", "3")
+        monkeypatch.setenv("TORCHFT_SERVE_COMPRESS", "int8")
+        monkeypatch.setenv("TORCHFT_SERVE_DRAIN_ON", "eject")
+        cfg = ServeConfig.from_env()
+        assert cfg.max_lag == 3
+        assert cfg.compress == "int8"
+        assert cfg.drain_on == "eject"
+        # explicit overrides beat the environment
+        assert ServeConfig.from_env(max_lag=9).max_lag == 9
+
+    @pytest.mark.parametrize(
+        "field,value",
+        [
+            ("max_lag", 0),
+            ("compress", "zstd"),
+            ("drain_on", "never"),
+            ("poll_s", 0.0),
+            ("timeout_s", -1.0),
+        ],
+    )
+    def test_validate_rejects(self, field, value):
+        cfg = _cfg(**{field: value})
+        with pytest.raises(ValueError) as e:
+            cfg.validate()
+        # error text must name the env var so `doctor` output is actionable
+        assert "TORCHFT_SERVE_" in str(e.value)
+
+    def test_codec_roundtrip_off_mode(self):
+        # "off" is raw f32 bytes — not a codec("off") call, which raises
+        delta = np.linspace(-1, 1, 257, dtype=np.float32)
+        for mode in ("off", "fp8", "int8"):
+            wire = encode_delta(delta, mode)
+            out = decode_delta(wire, mode, delta.size)
+            assert out.dtype == np.float32 and out.shape == delta.shape
+            if mode == "off":
+                np.testing.assert_array_equal(out, delta)
+
+    def test_flatten_params_deterministic(self):
+        p = {"a": np.arange(6, dtype=np.float32).reshape(2, 3), "b": 1.0}
+        f1, l1 = flatten_params(p)
+        f2, l2 = flatten_params(p)
+        np.testing.assert_array_equal(f1, f2)
+        assert l1["sig"] == l2["sig"]
+
+
+# ---------------------------------------------------------------- registry
+class TestRegistryProtocol:
+    def _announce(self, reg, rid, epoch, seq, version, chain="c1"):
+        return reg.announce(
+            {
+                "replica_id": rid,
+                "epoch": epoch,
+                "seq": seq,
+                "quorum_id": version[0],
+                "step": version[1],
+                "full_url": "http://127.0.0.1:1/full",
+                "delta_url": "http://127.0.0.1:1/delta",
+                "chain": chain,
+            }
+        )
+
+    def test_version_monotone_across_reconfigure(self):
+        """Per-replica versions are strictly monotone on (quorum_id, step):
+        replays and rewinds get 409, and a reconfigure (quorum_id bump
+        with the step counter continuing) is accepted — the lexicographic
+        order makes (2, 5) > (1, 7)."""
+        reg = SnapshotRegistry()
+        try:
+            _, body = reg.register("r0")
+            epoch = body["epoch"]
+            code, _ = self._announce(reg, "r0", epoch, 1, (1, 5))
+            assert code == 200
+            code, resp = self._announce(reg, "r0", epoch, 2, (1, 5))
+            assert code == 409 and resp["error"] == "stale_version"
+            code, resp = self._announce(reg, "r0", epoch, 3, (1, 4))
+            assert code == 409 and resp["error"] == "stale_version"
+            # seq replay is rejected independently of the version
+            code, resp = self._announce(reg, "r0", epoch, 1, (1, 6))
+            assert code == 409 and resp["error"] == "stale_seq"
+            code, _ = self._announce(reg, "r0", epoch, 4, (1, 7))
+            assert code == 200
+            # reconfigure: quorum_id bumps, step keeps counting upward
+            code, resp = self._announce(reg, "r0", epoch, 5, (2, 8))
+            assert code == 200
+            assert resp["latest"] == [2, 8]
+        finally:
+            reg.shutdown()
+
+    def test_stale_registry_rejection_after_restart(self):
+        """A publisher that announces under a pre-restart epoch gets 409
+        stale_epoch; re-registering under the new epoch succeeds.  The
+        SnapshotPublisher does that handshake automatically."""
+        reg = SnapshotRegistry()
+        port = reg._server.server_address[1]
+        try:
+            _, body = reg.register("r0")
+            old_epoch = body["epoch"]
+            assert self._announce(reg, "r0", old_epoch, 1, (1, 0))[0] == 200
+        finally:
+            reg.shutdown()
+
+        # "restart" the lighthouse registry on the same port: fresh epoch,
+        # empty source table
+        reg2 = SnapshotRegistry(port=port)
+        try:
+            assert reg2.epoch != old_epoch
+            code, resp = self._announce(reg2, "r0", old_epoch, 2, (1, 1))
+            assert code == 409 and resp["error"] == "stale_epoch"
+            assert reg2.sources()["sources"] == []
+
+            # the real publisher retries the handshake transparently
+            pub = SnapshotPublisher("r0", config=_cfg(), registry_url=reg2.url)
+            try:
+                pub._epoch = old_epoch  # pretend we registered pre-restart
+                pub._seq = 7
+                assert pub.publish(1, 2, _params()) == (1, 2)
+                listing = reg2.sources()
+                assert listing["latest"] == [1, 2]
+                assert listing["sources"][0]["replica_id"] == "r0"
+            finally:
+                pub.shutdown()
+        finally:
+            reg2.shutdown()
+
+    def test_sources_order_drained_at_tail(self):
+        reg = SnapshotRegistry()
+        try:
+            _, b0 = reg.register("r0")
+            _, b1 = reg.register("r1")
+            assert self._announce(reg, "r0", b0["epoch"], 1, (1, 3))[0] == 200
+            assert self._announce(reg, "r1", b1["epoch"], 1, (1, 4))[0] == 200
+            listing = reg.sources()
+            assert [s["replica_id"] for s in listing["sources"]] == ["r1", "r0"]
+            # drain the tip: it moves to the tail but keeps serving, and
+            # "latest" re-resolves over the healthy pool
+            reg.drain("r1", True)
+            listing = reg.sources()
+            assert [s["replica_id"] for s in listing["sources"]] == ["r0", "r1"]
+            assert listing["sources"][1]["draining"] is True
+            assert listing["latest"] == [1, 3]
+            # fully drained fleet still serves rather than going dark
+            reg.drain("r0", True)
+            listing = reg.sources()
+            assert len(listing["sources"]) == 2
+            assert listing["latest"] == [1, 4]
+        finally:
+            reg.shutdown()
+
+    def test_registry_client_structured_409_not_retried(self):
+        reg = SnapshotRegistry()
+        try:
+            client = RegistryClient(reg.url, timeout=3.0)
+            epoch = client.register("r0")
+            body = {
+                "replica_id": "r0", "epoch": epoch, "seq": 1,
+                "quorum_id": 1, "step": 0,
+                "full_url": "u", "delta_url": "u", "chain": "c",
+            }
+            code, _ = client.announce(body)
+            assert code == 200
+            t0 = time.monotonic()
+            code, resp = client.announce(body)  # seq replay
+            assert code == 409 and resp["error"] == "stale_seq"
+            # a structured rejection returns immediately — it must not
+            # burn the retry budget the way a connection error would
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            reg.shutdown()
+
+
+# ------------------------------------------------------- drain-before-eject
+class TestDrainBeforeEject:
+    def _health(self, states: Dict[str, str], excluded=()) -> Dict:
+        return {
+            "replicas": {r: {"state": s} for r, s in states.items()},
+            "excluded": list(excluded),
+        }
+
+    def test_warn_drains_before_eject(self):
+        """Under drain_on="warn" the serving plane reacts one escalation
+        level EARLIER than training: the replica leaves the rotation at
+        WARN, while healthwatch only ejects later.  The observable
+        ordering is: drained-while-still-in-quorum, then ejected."""
+        reg = SnapshotRegistry(drain_on="warn")
+        try:
+            _, b0 = reg.register("r0")
+            _, b1 = reg.register("r1")
+            for rid, b in (("r0", b0), ("r1", b1)):
+                code, _ = TestRegistryProtocol._announce(
+                    self, reg, rid, b["epoch"], 1, (1, 1)
+                )
+                assert code == 200
+
+            order: List[Tuple[str, str]] = []
+
+            # scripted escalation, the same path healthwatch walks
+            reg.apply_health(self._health({"r0": "ok", "r1": "ok"}))
+            assert reg.sources()["draining"] == []
+
+            reg.apply_health(self._health({"r0": "ok", "r1": "warn"}))
+            if "r1" in reg.sources()["draining"]:
+                order.append(("r1", "drained_at_warn"))
+
+            reg.apply_health(
+                self._health({"r0": "ok", "r1": "ejected"}, excluded=["r1"])
+            )
+            if "r1" in reg.sources()["draining"]:
+                order.append(("r1", "drained_at_eject"))
+
+            assert order == [
+                ("r1", "drained_at_warn"),
+                ("r1", "drained_at_eject"),
+            ], "serving must drain at WARN, strictly before training ejects"
+
+            # recovery: back to ok -> back in rotation
+            reg.apply_health(self._health({"r0": "ok", "r1": "ok"}))
+            assert reg.sources()["draining"] == []
+        finally:
+            reg.shutdown()
+
+    def test_eject_policy_serves_through_warn(self):
+        reg = SnapshotRegistry(drain_on="eject")
+        try:
+            _, b0 = reg.register("r0")
+            code, _ = TestRegistryProtocol._announce(
+                self, reg, "r0", b0["epoch"], 1, (1, 1)
+            )
+            assert code == 200
+            reg.apply_health(self._health({"r0": "warn"}))
+            assert reg.sources()["draining"] == []
+            reg.apply_health(self._health({"r0": "ejected"}))
+            assert reg.sources()["draining"] == ["r0"]
+        finally:
+            reg.shutdown()
+
+    def test_serving_eligible_matrix(self):
+        assert serving_eligible("ok", "warn")
+        assert not serving_eligible("warn", "warn")
+        assert not serving_eligible("ejected", "warn")
+        assert not serving_eligible("probation", "warn")
+        assert serving_eligible("warn", "eject")
+        assert not serving_eligible("ejected", "eject")
+        # unknown states fail TOWARD draining, never toward serving
+        assert not serving_eligible("gibberish", "warn")
+        with pytest.raises(ValueError):
+            serving_eligible("ok", "sometimes")
+
+    def test_ledger_escalation_drives_drain_ordering(self):
+        """End-to-end against the real HealthLedger: as a replica's state
+        machine escalates OK→WARN→EJECTED, serving eligibility (warn
+        policy) flips strictly before the eject event fires."""
+        cfg = HealthConfig(
+            mode="eject", window=8, min_samples=3, warn_z=2.0, eject_z=4.0,
+            eject_steps=2, probation_ms=1000, probe_ok=2,
+        )
+        ledger = HealthLedger(cfg, min_replicas=1)
+        drained_at: Optional[int] = None
+        ejected_at: Optional[int] = None
+        for step in range(20):
+            now_ms = (step + 1) * 1000.0
+            for rid, step_s in (("fast1", 1.0), ("fast2", 1.0), ("slow", 40.0)):
+                ledger.on_heartbeat(
+                    rid, {"step": step, "step_s": step_s, "wire_s": 0.0}, now_ms
+                )
+            state = ledger.state_of("slow")
+            if drained_at is None and not serving_eligible(state, "warn"):
+                drained_at = step
+            if state.name.lower() == "ejected":
+                ejected_at = step
+                break
+        assert drained_at is not None and ejected_at is not None
+        assert drained_at <= ejected_at, (
+            f"drained at step {drained_at} but ejected at {ejected_at}"
+        )
+
+
+# ------------------------------------------------------- wire equivalence
+class TestBitwiseEquivalence:
+    @pytest.mark.parametrize("mode", ["off", "fp8", "int8"])
+    def test_delta_vs_full_bitwise_equal(self, mode):
+        """Worker A full-pulls v0 then walks deltas to vN; worker B cold
+        full-pulls vN.  Both must equal the publisher's reference bit for
+        bit — compression error lives in the training-side residual, never
+        in divergence between pull paths."""
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url, compress=mode)
+        pub = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        wa = ServeWorker(reg.url, config=cfg, name="wa", start=False)
+        try:
+            params = _params(2048, seed=3)
+            assert pub.publish(1, 0, params) == (1, 0)
+            assert wa.pull_once() and wa.version == (1, 0)
+            assert wa.counters["full_pulls_total"] == 1
+
+            for step in range(1, 5):
+                params["w"] = params["w"] * 0.999 + np.float32(0.01 * step)
+                assert pub.publish(1, step, params) == (1, step)
+                assert wa.pull_once()
+            assert wa.version == (1, 4)
+            assert wa.counters["full_pulls_total"] == 1
+            assert wa.counters["delta_pulls_total"] == 4
+
+            wb = ServeWorker(reg.url, config=cfg, name="wb", start=False)
+            try:
+                assert wb.pull_once() and wb.version == (1, 4)
+                assert wb.counters["full_pulls_total"] == 1
+                assert wb.counters["delta_pulls_total"] == 0
+
+                ref = pub.ref_flat()
+                np.testing.assert_array_equal(wa.params_flat(), ref)
+                np.testing.assert_array_equal(wb.params_flat(), ref)
+                if mode == "off":
+                    # uncompressed chain: the reference tracks the actual
+                    # params up to f32 accumulation rounding — R + (P - R)
+                    # is not exactly P in float arithmetic, so this is
+                    # allclose, while worker-vs-reference stays BITWISE
+                    expect, _ = flatten_params(params)
+                    np.testing.assert_allclose(ref, expect, rtol=1e-6, atol=1e-7)
+            finally:
+                wb.shutdown()
+        finally:
+            wa.shutdown()
+            pub.shutdown()
+            reg.shutdown()
+
+    def test_delta_moves_fewer_bytes(self):
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url, compress="fp8")
+        pub = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            params = _params(8192, seed=1)
+            pub.publish(1, 0, params)
+            assert w.pull_once()
+            params["w"] = params["w"] + np.float32(0.5)
+            pub.publish(1, 1, params)
+            assert w.pull_once()
+            c = w.counters
+            assert c["full_bytes_total"] > 0 and c["delta_bytes_total"] > 0
+            # fp8 delta ≈ n bytes + header vs full ≈ 4n bytes + pickle
+            assert c["full_bytes_total"] > 3 * c["delta_bytes_total"]
+        finally:
+            w.shutdown()
+            pub.shutdown()
+            reg.shutdown()
+
+    def test_lag_beyond_max_forces_full_pull(self):
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url, compress="fp8", max_lag=2)
+        pub = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            params = _params(1024, seed=2)
+            pub.publish(1, 0, params)
+            assert w.pull_once() and w.version == (1, 0)
+            # publish 4 more versions while the worker sleeps: lag 4 > 2
+            for step in range(1, 5):
+                params["w"] = params["w"] + np.float32(0.1)
+                pub.publish(1, step, params)
+            assert w.pull_once() and w.version == (1, 4)
+            assert w.counters["full_pulls_total"] == 2
+            assert w.counters["delta_pulls_total"] == 0
+            np.testing.assert_array_equal(w.params_flat(), pub.ref_flat())
+        finally:
+            w.shutdown()
+            pub.shutdown()
+            reg.shutdown()
+
+
+# ------------------------------------------------------- failover matrix
+class TestWorkerFailover:
+    def _fleet(self, mode="fp8", n=2048):
+        """Registry plus two lockstep publishers holding identical state."""
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url, compress=mode)
+        pubs = [
+            SnapshotPublisher(f"r{i}", config=cfg, registry_url=reg.url)
+            for i in range(2)
+        ]
+        params = _params(n, seed=11)
+        for step in range(2):
+            if step:
+                params["w"] = params["w"] + np.float32(0.25)
+            for pub in pubs:
+                # a co-publisher's FIRST publish may return None: its
+                # bootstrap adopts the version the other replica already
+                # announced (documented "already covered" behavior)
+                assert pub.publish(1, step, params) in ((1, step), None)
+        for pub in pubs:
+            assert pub.version == (1, 1)
+        np.testing.assert_array_equal(pubs[0].ref_flat(), pubs[1].ref_flat())
+        return reg, cfg, pubs, params
+
+    def test_full_pull_fails_over_dead_source(self):
+        reg, cfg, pubs, _ = self._fleet()
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            pubs[0].kill()  # dead at connect: both serve endpoints gone
+            assert w.pull_once() and w.version == (1, 1)
+            np.testing.assert_array_equal(w.params_flat(), pubs[1].ref_flat())
+        finally:
+            w.shutdown()
+            for p in pubs:
+                p.shutdown()
+            reg.shutdown()
+
+    def test_full_pull_fails_over_mid_stream(self):
+        reg, cfg, pubs, _ = self._fleet(n=8192)
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            # every serve from r0's transport dies halfway through the span
+            pubs[0]._transport.inject_chunk_fault(0, "die", times=-1)
+            assert w.pull_once() and w.version == (1, 1)
+            np.testing.assert_array_equal(w.params_flat(), pubs[1].ref_flat())
+            assert w.counters["pull_failovers_total"] >= 1
+        finally:
+            w.shutdown()
+            for p in pubs:
+                p.shutdown()
+            reg.shutdown()
+
+    def test_delta_pull_fails_over_dead_source(self):
+        reg, cfg, pubs, params = self._fleet()
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            assert w.pull_once() and w.version == (1, 1)
+            pubs[0].kill()
+            params["w"] = params["w"] + np.float32(0.5)
+            assert pubs[1].publish(1, 2, params) == (1, 2)
+            assert w.pull_once() and w.version == (1, 2)
+            assert w.counters["delta_pulls_total"] >= 1
+            np.testing.assert_array_equal(w.params_flat(), pubs[1].ref_flat())
+        finally:
+            w.shutdown()
+            for p in pubs:
+                p.shutdown()
+            reg.shutdown()
+
+    def test_delta_pull_fails_over_dropped_connection(self):
+        """r0 answers the manifest but drops every delta blob connection
+        (the injector's "die" action); the worker must fail over to r1 and
+        still converge bitwise."""
+        reg, cfg, pubs, params = self._fleet()
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            assert w.pull_once() and w.version == (1, 1)
+
+            def hook(event: str, info: Dict) -> Optional[str]:
+                if event == "delta_request" and info["replica_id"] == "r0":
+                    return "die"
+                return None
+
+            set_serve_fault_hook(hook)
+            params["w"] = params["w"] + np.float32(0.5)
+            for pub in pubs:
+                assert pub.publish(1, 2, params) == (1, 2)
+            assert w.pull_once() and w.version == (1, 2)
+            assert w.counters["pull_failovers_total"] >= 1
+            np.testing.assert_array_equal(w.params_flat(), pubs[1].ref_flat())
+        finally:
+            set_serve_fault_hook(None)
+            w.shutdown()
+            for p in pubs:
+                p.shutdown()
+            reg.shutdown()
+
+    def test_infer_never_fails_during_source_loss(self):
+        """The request plane answers from the last applied snapshot under
+        a local lock — killing every source must not fail /infer."""
+        reg, cfg, pubs, _ = self._fleet()
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            assert w.pull_once()
+            before = w.answer(seed=42)
+            for p in pubs:
+                p.kill()
+            assert w.pull_once() is False  # nothing new reachable
+            after = w.answer(seed=42)
+            assert before["result"] == after["result"]
+            assert after["version"] == [1, 1]
+        finally:
+            w.shutdown()
+            for p in pubs:
+                p.shutdown()
+            reg.shutdown()
+
+
+# ------------------------------------------------------- publisher lifecycle
+class TestPublisherLifecycle:
+    def test_bootstrap_joins_existing_chain(self):
+        """A publisher that missed versions re-seats its reference via a
+        worker-style full pull and then extends the SAME chain — no fork."""
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url)
+        p0 = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        try:
+            params = _params(1024, seed=5)
+            p0.publish(1, 0, params)
+            params["w"] = params["w"] + np.float32(0.1)
+            p0.publish(1, 1, params)
+
+            p1 = SnapshotPublisher("r1", config=cfg, registry_url=reg.url)
+            try:
+                params["w"] = params["w"] + np.float32(0.1)
+                assert p1.publish(1, 2, params) == (1, 2)
+                assert p1.chain == p0.chain
+                assert p1.counters["bootstrap_pulls_total"] == 1
+
+                # a worker mid-chain keeps delta-walking across the handoff
+                w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+                try:
+                    assert w.pull_once() and w.version == (1, 2)
+                    np.testing.assert_array_equal(
+                        w.params_flat(), p1.ref_flat()
+                    )
+                finally:
+                    w.shutdown()
+            finally:
+                p1.shutdown()
+        finally:
+            p0.shutdown()
+            reg.shutdown()
+
+    def test_async_publish_drop_oldest(self):
+        """publish_async is the commit-path entry: it must never block and
+        the single-slot queue keeps only the newest pending snapshot."""
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url)
+        pub = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        try:
+            params = _params(1024, seed=9)
+            for step in range(6):
+                params["w"] = params["w"] + np.float32(0.01)
+                pub.publish_async(1, step, params)
+            assert pub.flush(timeout=5.0)
+            assert pub.version is not None
+            assert pub.version[1] == 5  # newest always wins
+            w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+            try:
+                assert w.pull_once() and w.version == pub.version
+                np.testing.assert_array_equal(w.params_flat(), pub.ref_flat())
+            finally:
+                w.shutdown()
+        finally:
+            pub.shutdown()
+            reg.shutdown()
+
+    def test_layout_change_resets_chain(self):
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url)
+        pub = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+        try:
+            pub.publish(1, 0, _params(512, seed=1))
+            assert w.pull_once()
+            chain0 = pub.chain
+            pub.publish(1, 1, _params(768, seed=1))  # model grew
+            assert pub.chain != chain0
+            assert w.pull_once() and w.version == (1, 1)
+            assert w.counters["full_pulls_total"] == 2  # chain switch => full
+            np.testing.assert_array_equal(w.params_flat(), pub.ref_flat())
+        finally:
+            w.shutdown()
+            pub.shutdown()
+            reg.shutdown()
+
+
+# ------------------------------------------------------- worker loop
+class TestWorkerLoop:
+    def test_background_loop_tracks_publishes(self):
+        reg = SnapshotRegistry()
+        cfg = _cfg(reg.url, poll_s=0.01)
+        pub = SnapshotPublisher("r0", config=cfg, registry_url=reg.url)
+        w = ServeWorker(reg.url, config=cfg, name="w")  # start=True
+        try:
+            params = _params(1024, seed=4)
+            pub.publish(1, 0, params)
+            assert w.wait_version((1, 0), timeout=5.0)
+            params["w"] = params["w"] + np.float32(0.2)
+            pub.publish(1, 1, params)
+            assert w.wait_version((1, 1), timeout=5.0)
+            np.testing.assert_array_equal(w.params_flat(), pub.ref_flat())
+        finally:
+            w.shutdown()
+            pub.shutdown()
+            reg.shutdown()

@@ -36,6 +36,10 @@ _EXPORTS = {
     "LighthouseClient": "torchft_tpu.coordination",
     "ManagerServer": "torchft_tpu.coordination",
     "ManagerClient": "torchft_tpu.coordination",
+    "ServeConfig": "torchft_tpu.serving",
+    "ServeWorker": "torchft_tpu.serving",
+    "SnapshotPublisher": "torchft_tpu.serving",
+    "SnapshotRegistry": "torchft_tpu.serving",
 }
 
 __all__ = ["__version__", *_EXPORTS]

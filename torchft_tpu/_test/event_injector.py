@@ -79,6 +79,10 @@ class EventInjector:
         # transform (slow_replica); mutable mid-run so a soak can degrade
         # a replica and later let it recover
         self._slow: Dict[int, float] = {}
+        # serving-plane faults (kill_snapshot_source / delay_worker_pull):
+        # versions whose announcing publisher dies, and the pull delay spec
+        self._serve_kill_versions: set = set()
+        self._serve_pull_delay: Optional[Tuple[float, int]] = None
         self.count = 0
 
     def stall_prepare_at(self, replica: int, step: int) -> "EventInjector":
@@ -236,6 +240,77 @@ class EventInjector:
         from torchft_tpu import tracing
 
         tracing.clear_clock_offsets()
+
+    # ------------------------------------------------------- serving plane
+    def kill_snapshot_source(self, version: Tuple[int, int]) -> "EventInjector":
+        """Kill the serving replica that announces snapshot ``version``
+        (``(quorum_id, step)``): the publisher's delta AND full-pull
+        endpoints vanish the instant the version exists — the exact window
+        where workers are about to pull it.  Downstream, the registry must
+        drain the dead source (health/drain) and workers must fail over
+        mid-pull.  Installed via the process-wide serving fault hook; call
+        :meth:`clear_serve_faults` on teardown."""
+        with self._lock:
+            self._serve_kill_versions.add((int(version[0]), int(version[1])))
+        self._install_serve_hook()
+        return self
+
+    def delay_worker_pull(self, delay_s: float, times: int = 1) -> "EventInjector":
+        """Make the next ``times`` worker pull cycles (process-wide, any
+        worker) sleep ``delay_s`` before polling — the shape of a slow or
+        congested pull plane.  Lag gauges grow, the request plane must keep
+        answering from the last-applied version.  ``times=-1`` delays every
+        pull until cleared."""
+        with self._lock:
+            self._serve_pull_delay = (float(delay_s), int(times))
+        self._install_serve_hook()
+        return self
+
+    def clear_serve_faults(self) -> None:
+        from torchft_tpu import serving
+
+        with self._lock:
+            self._serve_kill_versions.clear()
+            self._serve_pull_delay = None
+        serving.set_serve_fault_hook(None)
+
+    def _install_serve_hook(self) -> None:
+        from torchft_tpu import serving
+
+        serving.set_serve_fault_hook(self._serve_fault_hook)
+
+    def _serve_fault_hook(self, event: str, info: Dict[str, object]):
+        if event == "worker_pull":
+            with self._lock:
+                spec = self._serve_pull_delay
+                if spec is None:
+                    return None
+                delay_s, times = spec
+                if times == 0:
+                    return None
+                if times > 0:
+                    self._serve_pull_delay = (delay_s, times - 1)
+                self.count += 1
+            time.sleep(delay_s)
+            return None
+        if event in ("announce", "delta_request"):
+            version = info.get("version")
+            with self._lock:
+                armed = (
+                    version is not None
+                    and tuple(version) in self._serve_kill_versions  # type: ignore[arg-type]
+                )
+                if armed:
+                    self._serve_kill_versions.discard(tuple(version))  # type: ignore[arg-type]
+                    self.count += 1
+            if armed and event == "announce":
+                publisher = info.get("publisher")
+                if publisher is not None:
+                    publisher.kill()  # type: ignore[union-attr]
+                return None
+            if armed:
+                return "die"
+        return None
 
     # ------------------------------------------------- control-plane flakes
     def flake_rpc(

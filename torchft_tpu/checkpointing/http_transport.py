@@ -118,8 +118,13 @@ class HTTPTransport(CheckpointTransport[Any]):
     def __init__(self, timeout: "float | timedelta" = 60.0, num_chunks: int = 0,
                  hostname: str = "",
                  state_dict_template: "Optional[Any]" = None,
-                 retry_policy: Optional[RetryPolicy] = None) -> None:
+                 retry_policy: Optional[RetryPolicy] = None,
+                 client_only: bool = False) -> None:
         self._timeout = _to_seconds(timeout)
+        # client_only: a pure receiver (serving-plane workers, bootstrap
+        # pulls) that never stages state — skip binding a listener so a
+        # fleet of pullers doesn't burn a port (and a thread) each
+        self._client_only = client_only
         self._num_chunks = num_chunks
         # per-chunk same-source retry budget + backoff for the recv side
         self._retry_policy = (
@@ -233,12 +238,17 @@ class HTTPTransport(CheckpointTransport[Any]):
                     except Exception:  # noqa: BLE001
                         pass
 
-        self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
-        self._server.daemon_threads = True
-        self._serve_thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="torchft_http_ckpt"
-        )
-        self._serve_thread.start()
+        if client_only:
+            self._server = None
+            self._serve_thread = None
+        else:
+            self._server = ThreadingHTTPServer(("0.0.0.0", 0), _Handler)
+            self._server.daemon_threads = True
+            self._serve_thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="torchft_http_ckpt",
+            )
+            self._serve_thread.start()
 
     # -- serving side -----------------------------------------------------
     def inject_chunk_fault(self, chunk: int, mode: str, times: int = 1) -> None:
@@ -348,9 +358,19 @@ class HTTPTransport(CheckpointTransport[Any]):
         return False
 
     def metadata(self) -> str:
+        if self._server is None:
+            raise RuntimeError(
+                "client_only transport has no serve address (metadata())"
+            )
         host = self._hostname or socket.gethostname()
         port = self._server.server_address[1]
         return f"http://{host}:{port}"
+
+    def staged_step(self) -> "Optional[int]":
+        """Step currently staged for serving, or None when the window is
+        closed (serving-plane introspection; reads one attribute)."""
+        staged = self._staged
+        return staged[0] if staged is not None else None
 
     def send_checkpoint(
         self, dst_ranks: List[int], step: int, state_dict: Any, timeout
@@ -360,6 +380,8 @@ class HTTPTransport(CheckpointTransport[Any]):
         HTTP is pull-based: "send" = make available to ``dst_ranks`` until
         ``disallow_checkpoint`` re-locks (reference: http_transport.py:219-241).
         """
+        if self._server is None:
+            raise RuntimeError("client_only transport cannot stage checkpoints")
         spec, payloads = flatten_state(state_dict)
         leaf_nbytes = [m.nbytes for m in spec.leaves]
         total = sum(leaf_nbytes)
@@ -685,6 +707,8 @@ class HTTPTransport(CheckpointTransport[Any]):
             timings.total_bytes += attempt_bytes
 
     def shutdown(self, wait: bool = True) -> None:
+        if self._server is None:
+            return
         self._server.shutdown()
         self._server.server_close()
         if wait:

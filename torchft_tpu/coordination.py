@@ -328,6 +328,8 @@ class LighthouseServer:
         health: "Optional[dict]" = None,
         history_path: str = "",
         metrics_per_replica_limit: "Optional[int]" = None,
+        serve_registry: bool = False,
+        serve_drain_on: "Optional[str]" = None,
     ) -> None:
         """``health`` configures the healthwatch ledger (HealthOpts fields,
         see torchft_tpu/healthwatch.py); None reads ``TORCHFT_HEALTH_*``
@@ -337,7 +339,11 @@ class LighthouseServer:
         via :func:`history_replay` (empty = disabled).
         ``metrics_per_replica_limit`` caps per-replica /metrics series (the
         tail collapses into min/median/max aggregates); None reads
-        ``TORCHFT_METRICS_PER_REPLICA_LIMIT`` (default 64)."""
+        ``TORCHFT_METRICS_PER_REPLICA_LIMIT`` (default 64).
+        ``serve_registry=True`` co-hosts a serving-plane SnapshotRegistry
+        that polls this lighthouse's /health summary to drain unhealthy
+        sources (``serve_drain_on``: "warn"/"eject", None reads
+        ``TORCHFT_SERVE_DRAIN_ON``); see docs/serving.md."""
         lib = _load()
         if health is None:
             from torchft_tpu.healthwatch import HealthConfig
@@ -365,6 +371,20 @@ class LighthouseServer:
         _raise_for_status(status, _take_str(lib, err), "lighthouse start failed")
         self._lib = lib
         self._handle = handle
+        self.serve_registry = None
+        if serve_registry:
+            # lazy import: the serving plane is optional and serving.py
+            # imports back into this module for its health poll client
+            from torchft_tpu.serving import SERVE_DRAIN_ON_ENV, SnapshotRegistry
+
+            drain_on = (
+                serve_drain_on
+                if serve_drain_on is not None
+                else os.environ.get(SERVE_DRAIN_ON_ENV, "warn").strip() or "warn"
+            )
+            self.serve_registry = SnapshotRegistry(
+                lighthouse_addr=self.address(), drain_on=drain_on
+            )
 
     def address(self) -> str:
         return _take_str(self._lib, self._lib.tft_lighthouse_address(self._handle))
@@ -373,7 +393,13 @@ class LighthouseServer:
     def port(self) -> int:
         return self._lib.tft_lighthouse_port(self._handle)
 
+    def serve_registry_url(self) -> "Optional[str]":
+        return self.serve_registry.url if self.serve_registry is not None else None
+
     def shutdown(self) -> None:
+        if self.serve_registry is not None:
+            self.serve_registry.shutdown()
+            self.serve_registry = None
         if self._handle:
             self._lib.tft_lighthouse_shutdown(self._handle)
 

@@ -526,6 +526,100 @@ def check_aggregator() -> Result:
         return False, f"aggregator probe failed: {e}"
 
 
+def check_serve_env() -> Result:
+    """``TORCHFT_SERVE_*`` sanity: the env contract parses into a valid
+    ServeConfig (same validation path the worker CLI, the registry, and
+    the publisher all funnel through — doctor and serving plane reject
+    identically).  A configured-but-unreachable registry is a warn, not a
+    fail: the serving plane is optional and workers retry."""
+    try:
+        from torchft_tpu.serving import ServeConfig
+
+        cfg = ServeConfig.from_env()
+    except ValueError as e:
+        return False, f"TORCHFT_SERVE_* invalid: {e}"
+    if not cfg.registry:
+        return True, (
+            f"serving plane unconfigured (compress={cfg.compress}, "
+            f"max_lag={cfg.max_lag}, drain_on={cfg.drain_on}); set "
+            "TORCHFT_SERVE_REGISTRY to enable"
+        )
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"{cfg.registry.rstrip('/')}/serve/sources", timeout=3.0
+        ) as r:
+            listing = json.loads(r.read().decode())
+    except Exception as e:  # noqa: BLE001 — unreachable is a warn
+        return None, (
+            f"TORCHFT_SERVE_REGISTRY={cfg.registry} unreachable ({e!r}); "
+            "workers will retry, but check the lighthouse --serve-registry "
+            "flag / the registry process"
+        )
+    return True, (
+        f"registry at {cfg.registry}: {len(listing.get('sources', []))} "
+        f"source(s), latest={listing.get('latest')}, "
+        f"epoch={listing.get('epoch')}"
+    )
+
+
+def check_serving_roundtrip() -> Result:
+    """Loopback serving probe: registry + one publisher + one worker pull.
+    Publishes two tiny versions, asserts the worker lands on the newest
+    via a full pull then a compressed delta, bitwise-equal to the
+    publisher's reference — the whole plane (announce, source ordering,
+    ranged full pull, delta walk, error-feedback replay) in one breath."""
+    import numpy as np
+
+    from torchft_tpu.serving import (
+        ServeConfig,
+        ServeWorker,
+        SnapshotPublisher,
+        SnapshotRegistry,
+    )
+
+    registry = SnapshotRegistry()
+    cfg = ServeConfig(
+        registry=registry.url, max_lag=4, compress="fp8",
+        poll_s=0.02, timeout_s=10.0,
+    )
+    publisher = SnapshotPublisher(
+        "doctor_replica", config=cfg, registry_url=registry.url
+    )
+    worker = ServeWorker(registry.url, config=cfg, name="doctor_worker")
+    try:
+        rng = np.random.RandomState(7)
+        params = {"w": rng.randn(4096).astype(np.float32)}
+        publisher.publish(1, 0, params)
+        if not worker.wait_version((1, 0), timeout=10.0):
+            return False, (
+                f"worker never reached (1, 0): counters={worker.counters}"
+            )
+        params["w"] = params["w"] + 0.01
+        publisher.publish(1, 1, params)
+        if not worker.wait_version((1, 1), timeout=10.0):
+            return False, (
+                f"worker stuck at {worker.version} (want (1, 1)): "
+                f"counters={worker.counters}"
+            )
+        if not np.array_equal(worker.params_flat(), publisher.ref_flat()):
+            return False, (
+                "worker params != publisher reference after pull — the "
+                "bitwise delta/full invariant broke"
+            )
+        c = worker.counters
+        return True, (
+            f"worker converged to (1, 1): {c['full_pulls_total']} full + "
+            f"{c['delta_pulls_total']} delta pull(s), "
+            f"{c['delta_bytes_total']}B delta vs {c['full_bytes_total']}B full"
+        )
+    finally:
+        worker.shutdown()
+        publisher.shutdown()
+        registry.shutdown()
+
+
 CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("native", check_native),
     ("accelerator", check_accelerator),
@@ -535,10 +629,12 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("retry-env", check_retry_env),
     ("health-env", check_health_env),
     ("compress-env", check_compress_env),
+    ("serve-env", check_serve_env),
     ("trace-env", check_trace_env),
     ("health-http", check_health_endpoint),
     ("metrics-http", check_metrics_endpoints),
     ("heal", check_heal_roundtrip),
+    ("serving", check_serving_roundtrip),
 ]
 
 

@@ -222,6 +222,51 @@ class HealthState(IntEnum):
     PROBATION = 3
 
 
+# Serving-plane drain policy (docs/serving.md): which health states pull a
+# replica OUT of the snapshot-serving set.  ``"warn"`` (the default) drains
+# at the first WARN strike — strictly BEFORE the warn→eject escalation
+# removes the replica from training, so inference traffic never routes to
+# a replica the ledger is already suspicious of.  ``"eject"`` only drains
+# replicas the ledger has actually ejected (lenient; more serving capacity
+# at the cost of routing to stragglers).
+SERVE_DRAIN_STATES: Dict[str, Tuple[HealthState, ...]] = {
+    "warn": (HealthState.WARN, HealthState.EJECTED, HealthState.PROBATION),
+    "eject": (HealthState.EJECTED,),
+}
+
+_STATE_NAMES = {
+    "ok": HealthState.OK,
+    "warn": HealthState.WARN,
+    "ejected": HealthState.EJECTED,
+    "probation": HealthState.PROBATION,
+}
+
+
+def serving_eligible(
+    state: "HealthState | int | str", drain_on: str = "warn"
+) -> bool:
+    """True when a replica in ``state`` may serve inference traffic.
+
+    Accepts the native /health JSON state string ("ok"/"warn"/...), the
+    IntEnum, or its integer code, so the registry can gate on whichever
+    health source it polls.  Unknown states are treated as NOT eligible —
+    fail toward draining, never toward routing at a sick replica."""
+    if drain_on not in SERVE_DRAIN_STATES:
+        raise ValueError(
+            f"drain_on must be one of {tuple(SERVE_DRAIN_STATES)}, got {drain_on!r}"
+        )
+    if isinstance(state, str):
+        parsed = _STATE_NAMES.get(state.strip().lower())
+        if parsed is None:
+            return False
+        state = parsed
+    try:
+        state = HealthState(int(state))
+    except (ValueError, TypeError):
+        return False
+    return state not in SERVE_DRAIN_STATES[drain_on]
+
+
 @dataclass
 class _Replica:
     window: List[float] = field(default_factory=list)

@@ -47,6 +47,20 @@ def main(argv: "list[str] | None" = None) -> None:
         "events / telemetry snapshots; replay with "
         "`python -m torchft_tpu.trace history PATH` (default: disabled)",
     )
+    parser.add_argument(
+        "--serve-registry", "--serve_registry",
+        action="store_true",
+        help="co-host a serving-plane snapshot registry that health-gates "
+        "inference routing off this lighthouse's /health ledger "
+        "(docs/serving.md)",
+    )
+    parser.add_argument(
+        "--serve-drain-on", "--serve_drain_on",
+        default=None,
+        choices=("warn", "eject"),
+        help="health state at which the registry drains a serving source "
+        "(default: $TORCHFT_SERVE_DRAIN_ON or warn)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -57,8 +71,16 @@ def main(argv: "list[str] | None" = None) -> None:
         quorum_tick_ms=args.quorum_tick_ms,
         heartbeat_timeout_ms=args.heartbeat_timeout_ms,
         history_path=args.history,
+        serve_registry=args.serve_registry,
+        serve_drain_on=args.serve_drain_on,
     )
     logging.info("lighthouse listening at %s", server.address())
+    if server.serve_registry is not None:
+        logging.info(
+            "snapshot registry serving at %s (epoch %s)",
+            server.serve_registry.url,
+            server.serve_registry.epoch,
+        )
 
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):

@@ -455,6 +455,10 @@ class Manager:
         ] = None
         self._last_health_state: Optional[str] = None
         self._last_commit_t: Optional[float] = None
+        # serving plane (attach_serve_publisher): committed snapshots are
+        # published as (quorum_id, step) versions; None = plane disabled
+        self._serve_publisher: Optional[Any] = None
+        self._serve_params_fn: Optional[Callable[[], Any]] = None
         self._last_vote_committed = False
         self._telemetry_quorum_id: Optional[int] = None
         self._participating_replica_rank: Optional[int] = None
@@ -2151,6 +2155,41 @@ class Manager:
             )
         return out
 
+    # ------------------------------------------------------ serving plane
+    def attach_serve_publisher(
+        self,
+        publisher: Any,
+        params_fn: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        """Attach a serving-plane SnapshotPublisher: every committed step
+        is published as a versioned snapshot stamped ``(quorum_id, step)``
+        (docs/serving.md).  ``params_fn`` selects what to publish (default:
+        the registered user state dict).  Group leader only — follower
+        ranks ignore the attach so a replica announces exactly once.
+        Publishing is advisory: failures log, they never fail a commit."""
+        if self._group_rank != 0:
+            return
+        self._serve_publisher = publisher
+        self._serve_params_fn = (
+            params_fn if params_fn is not None else self.user_state_dict
+        )
+
+    def _serve_publish_committed(self) -> None:
+        """Commit-path hook: hand the just-committed params to the
+        publisher.  The host copy happens here (so the next step cannot
+        tear the snapshot); encoding and announcing ride the publisher's
+        own thread.  Never raises — the serving plane is advisory."""
+        t0 = time.perf_counter()
+        try:
+            self._serve_publisher.publish_async(
+                self._quorum_id, self._step, self._serve_params_fn()
+            )
+            self._bump_counter("serve_published_total")
+        except Exception:  # noqa: BLE001 — advisory plane
+            self._bump_counter("serve_publish_errors_total")
+            self._logger.exception("serve snapshot publish failed")
+        self._record_timing("serve_publish_s", time.perf_counter() - t0)
+
     # -------------------------------------------------------- healthwatch
     def set_telemetry_transform(
         self, fn: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]]
@@ -2480,6 +2519,10 @@ class Manager:
             self._checkpoint_transport.disallow_checkpoint()
 
         if should_commit:
+            if self._serve_publisher is not None:
+                # publish the committed snapshot BEFORE the step advances:
+                # the serving version is stamped with the step that voted
+                self._serve_publish_committed()
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0

@@ -22,9 +22,11 @@ import urllib.request
 import uuid
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 from torchft_tpu.coordination import KvStoreServer
 from torchft_tpu.process_group import ProcessGroup, ProcessGroupHost
+from torchft_tpu.retry import RetryPolicy, retry_call
 
 logger = logging.getLogger(__name__)
 
@@ -49,6 +51,8 @@ class ParameterServer(ABC):
         self._timeout = timeout
         self._store = KvStoreServer("0.0.0.0:0")
         store_port = self._store.port
+        self._sessions_lock = threading.Lock()
+        self._sessions_live = 0
         ps = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -77,13 +81,31 @@ class ParameterServer(ABC):
                 # Hijack this handler thread for the session's server half
                 # (reference parameter_server.py:84-108).
                 pg = ProcessGroupHost(timeout=ps._timeout)
+                # Hard deadline on session SETUP: a client that handshakes
+                # but never configures its PG would otherwise hold this
+                # thread for however long the rendezvous internals block.
+                # The watchdog aborts the PG at ps._timeout, turning the
+                # wedge into an ordinary (logged, isolated) session error.
+                # forward() is the user protocol and manages its own
+                # timeouts through the PG, so the watchdog is disarmed the
+                # moment configure returns.
+                watchdog = threading.Timer(ps._timeout, pg.abort)
+                watchdog.daemon = True
+                with ps._sessions_lock:
+                    ps._sessions_live += 1
                 try:
-                    pg.configure(store_addr, 0, 2, quorum_id=0)
+                    watchdog.start()
+                    try:
+                        pg.configure(store_addr, 0, 2, quorum_id=0)
+                    finally:
+                        watchdog.cancel()
                     ps.forward(0, pg)
                 except Exception:  # noqa: BLE001 — per-session isolation
                     logger.exception("session %s failed", session_id)
                 finally:
                     pg.shutdown()
+                    with ps._sessions_lock:
+                        ps._sessions_live -= 1
 
         self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
         self._server.daemon_threads = True
@@ -95,18 +117,46 @@ class ParameterServer(ABC):
     def address(self) -> str:
         return f"http://{socket.gethostname()}:{self._server.server_port}"
 
+    def active_sessions(self) -> int:
+        """Sessions currently holding a hijacked handler thread (setup or
+        forward()); observability for tests and ops."""
+        with self._sessions_lock:
+            return self._sessions_live
+
     @classmethod
     def new_session(
-        cls, address: str, timeout: float = 60.0
+        cls,
+        address: str,
+        timeout: float = 60.0,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> ProcessGroup:
         """Client side: open a session against a running server; returns a
         configured two-member PG where the caller is rank 1
-        (reference parameter_server.py:110-139)."""
-        with urllib.request.urlopen(
-            urllib.request.Request(f"{address}/new_session", method="POST"),
+        (reference parameter_server.py:110-139).
+
+        The HTTP handshake retries under the standard ``TORCHFT_RETRY_*``
+        policy (``retry_policy`` overrides): a single connection refused
+        while the server is still binding its port is backoff-and-retry,
+        not fatal.  ``timeout`` is the hard wall-clock budget across all
+        handshake attempts AND the PG configure that follows."""
+        policy = retry_policy if retry_policy is not None else RetryPolicy.from_env()
+
+        def handshake(remaining: float) -> dict:
+            with urllib.request.urlopen(
+                urllib.request.Request(f"{address}/new_session", method="POST"),
+                timeout=max(remaining, 0.05),
+            ) as resp:
+                return json.loads(resp.read().decode())
+
+        info = retry_call(
+            handshake,
+            policy=policy,
             timeout=timeout,
-        ) as resp:
-            info = json.loads(resp.read().decode())
+            retryable=(OSError, TimeoutError, ValueError),
+            # a refused/reset connect usually means the server (re)started:
+            # full jitter de-packs the reconnect herd (see retry.py)
+            full_jitter_on=(ConnectionError,),
+        )
         pg = ProcessGroupHost(timeout=timeout)
         pg.configure(info["store_addr"], 1, 2, quorum_id=0)
         return pg

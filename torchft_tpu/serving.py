@@ -1,0 +1,1568 @@
+"""Serving plane: health-gated inference workers pulling versioned,
+compressed parameter snapshots from the training fleet.
+
+Topology (ROADMAP item 4, docs/serving.md):
+
+    trainers ──(commit path)──> SnapshotPublisher ──announce──> SnapshotRegistry
+                                    │    │                            │
+                              full pulls │ per-step deltas       health poll
+                         (HTTPTransport) │ (fp8/int8 wire)      (lighthouse)
+                                    ▼    ▼                            │
+                                  ServeWorker <──── /serve/sources ───┘
+                                    │
+                                  /infer traffic
+
+Every live replica publishes a **versioned parameter snapshot** stamped
+``(quorum_id, step)`` on the commit path.  Full snapshots are staged
+through the existing resumable checkpoint transport (ranged, crc32,
+multi-source failover — no new serialization plane); per-step **deltas**
+ride the PR 6 fp8/int8 codec with the same error-feedback discipline.
+
+Bitwise invariant: the publisher keeps an error-feedback *reference*
+``R`` and replays its own encoded delta on publish::
+
+    delta_v = encode(params_v - R_{v-1});  R_v = R_{v-1} + decode(delta_v)
+
+Full pulls serve ``R_v`` verbatim, so a worker that applies the delta
+chain and a worker that full-pulls land on **bitwise-identical** flats,
+in every compress mode.  The residual ``params - R`` stays bounded
+because each delta re-encodes the full drift (telescoping), exactly the
+allreduce error-feedback discipline.  A publisher that missed versions
+(healed, restarted) bootstraps ``R`` with a worker-style full pull from
+the registry's sources before publishing again, so all sources stay
+byte-interchangeable mid-delta-walk.
+
+Routing is health-gated: the registry polls the lighthouse ``/health``
+summary and **drains** a replica from the serving set at ``warn`` —
+strictly before healthwatch's warn→eject escalation removes it from
+training.  Workers answer ``/infer`` from their last-applied snapshot
+under a local lock, so a quorum reconfiguration (or a mid-pull source
+death) never fails a request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .observability import MetricsRegistry
+from .ops.quantization import (
+    COMPRESS_MODES,
+    compress_bucket,
+    decompress_bucket,
+)
+from .retry import RetryPolicy, retry_call
+
+logger = logging.getLogger(__name__)
+
+# --------------------------------------------------------------------------
+# Env contract (docs/serving.md)
+# --------------------------------------------------------------------------
+SERVE_REGISTRY_ENV = "TORCHFT_SERVE_REGISTRY"
+SERVE_MAX_LAG_ENV = "TORCHFT_SERVE_MAX_LAG"
+SERVE_COMPRESS_ENV = "TORCHFT_SERVE_COMPRESS"
+SERVE_POLL_S_ENV = "TORCHFT_SERVE_POLL_S"
+SERVE_DRAIN_ON_ENV = "TORCHFT_SERVE_DRAIN_ON"
+SERVE_PORT_ENV = "TORCHFT_SERVE_PORT"
+SERVE_TIMEOUT_S_ENV = "TORCHFT_SERVE_TIMEOUT_S"
+
+_DRAIN_POLICIES = ("warn", "eject")
+
+Version = Tuple[int, int]  # (quorum_id, step) — lexicographic order
+
+
+@dataclass
+class ServeConfig:
+    """Knobs for the serving plane (all overridable via TORCHFT_SERVE_*)."""
+
+    registry: str = ""  # registry base URL ("" = standalone/test)
+    max_lag: int = 8  # K: delta ring depth; >K behind -> full pull
+    compress: str = "fp8"  # delta wire mode: off | fp8 | int8
+    poll_s: float = 0.05  # worker poll interval
+    drain_on: str = "warn"  # health state that drains a source
+    port: int = 0  # worker HTTP port (0 = ephemeral)
+    timeout_s: float = 15.0  # per-pull / per-RPC deadline
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "ServeConfig":
+        def _pick(env: str, key: str, cast: Callable[[str], Any]) -> Any:
+            if key in overrides and overrides[key] is not None:
+                return overrides[key]
+            raw = os.environ.get(env)
+            if raw is None or not raw.strip():
+                return getattr(cls, key) if key != "registry" else ""
+            try:
+                return cast(raw.strip())
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"bad {env}={raw!r}: {e}") from e
+
+        cfg = cls(
+            registry=_pick(SERVE_REGISTRY_ENV, "registry", str),
+            max_lag=_pick(SERVE_MAX_LAG_ENV, "max_lag", int),
+            compress=_pick(SERVE_COMPRESS_ENV, "compress", str),
+            poll_s=_pick(SERVE_POLL_S_ENV, "poll_s", float),
+            drain_on=_pick(SERVE_DRAIN_ON_ENV, "drain_on", str),
+            port=_pick(SERVE_PORT_ENV, "port", int),
+            timeout_s=_pick(SERVE_TIMEOUT_S_ENV, "timeout_s", float),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        self.compress = str(self.compress).strip().lower()
+        self.drain_on = str(self.drain_on).strip().lower()
+        if self.compress not in COMPRESS_MODES:
+            raise ValueError(
+                f"invalid {SERVE_COMPRESS_ENV}={self.compress!r}: "
+                f"expected one of {COMPRESS_MODES}"
+            )
+        if self.drain_on not in _DRAIN_POLICIES:
+            raise ValueError(
+                f"invalid {SERVE_DRAIN_ON_ENV}={self.drain_on!r}: "
+                f"expected one of {_DRAIN_POLICIES}"
+            )
+        if self.max_lag < 1:
+            raise ValueError(f"invalid {SERVE_MAX_LAG_ENV}={self.max_lag}: must be >= 1")
+        if self.poll_s <= 0:
+            raise ValueError(f"invalid {SERVE_POLL_S_ENV}={self.poll_s}: must be > 0")
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"invalid {SERVE_TIMEOUT_S_ENV}={self.timeout_s}: must be > 0"
+            )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "registry": self.registry,
+            "max_lag": self.max_lag,
+            "compress": self.compress,
+            "poll_s": self.poll_s,
+            "drain_on": self.drain_on,
+            "port": self.port,
+            "timeout_s": self.timeout_s,
+        }
+
+
+# --------------------------------------------------------------------------
+# Fault hook (event_injector glue, mirrors coordination.set_rpc_fault_hook)
+# --------------------------------------------------------------------------
+_fault_hook: Optional[Callable[[str, Dict[str, Any]], Optional[str]]] = None
+_fault_lock = threading.Lock()
+
+
+def set_serve_fault_hook(
+    fn: Optional[Callable[[str, Dict[str, Any]], Optional[str]]],
+) -> None:
+    """Install a process-wide serving fault hook (test-only).
+
+    ``fn(event, info)`` fires at: ``"announce"`` (publisher announced a
+    version), ``"delta_request"`` (a delta is about to be served),
+    ``"worker_pull"`` (a worker is about to poll/pull).  Returning
+    ``"die"`` from a serve-side event drops the connection; the hook may
+    also sleep (pull delays) or call back into the harness (kills)."""
+    global _fault_hook
+    with _fault_lock:
+        _fault_hook = fn
+
+
+def _fire_fault(event: str, info: Dict[str, Any]) -> Optional[str]:
+    with _fault_lock:
+        fn = _fault_hook
+    if fn is None:
+        return None
+    try:
+        return fn(event, info)
+    except Exception:  # noqa: BLE001 — a broken hook must not break serving
+        logger.exception("serve fault hook failed on %s", event)
+        return None
+
+
+# --------------------------------------------------------------------------
+# Flat-vector codec helpers
+# --------------------------------------------------------------------------
+def flatten_params(params: Any) -> Tuple[np.ndarray, Dict[str, Any]]:
+    """Flatten a pytree (or flat array) of parameters into one contiguous
+    f32 host vector plus a layout descriptor.
+
+    Serving state is float32 end-to-end: every leaf is staged to host and
+    cast, concatenated in tree-flatten order.  The layout (shapes +
+    dtypes) rides along so mismatched sources are detected, not mixed."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot publish an empty parameter tree")
+    flats: List[np.ndarray] = []
+    layout_leaves: List[List[Any]] = []
+    for leaf in leaves:
+        host = np.asarray(leaf)
+        layout_leaves.append([list(host.shape), str(host.dtype)])
+        flats.append(np.ascontiguousarray(host, dtype=np.float32).ravel())
+    flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
+    flat = np.ascontiguousarray(flat, dtype=np.float32)
+    layout = {"n": int(flat.size), "leaves": layout_leaves}
+    layout["sig"] = layout_signature(layout)
+    return flat, layout
+
+
+def layout_signature(layout: Dict[str, Any]) -> str:
+    basis = {"n": layout["n"], "leaves": layout["leaves"]}
+    return hashlib.sha1(
+        json.dumps(basis, sort_keys=True).encode()
+    ).hexdigest()[:12]
+
+
+def encode_delta(delta: np.ndarray, mode: str) -> Any:
+    """Encode a flat f32 delta for the wire (CompressedWire or raw bytes)."""
+    if mode == "off":
+        return np.ascontiguousarray(delta, dtype=np.float32).tobytes()
+    return compress_bucket(
+        np.ascontiguousarray(delta, dtype=np.float32), mode, dtype=np.float32
+    )
+
+
+def decode_delta(wire: Any, mode: str, n: int) -> np.ndarray:
+    """Decode a wire delta back to a flat f32 vector of length ``n``.
+
+    This is THE reference decode: the publisher replays it to advance its
+    own error-feedback reference, so publisher and worker stay bitwise in
+    lockstep by construction."""
+    if mode == "off":
+        out = np.frombuffer(wire, dtype=np.float32).copy()
+    else:
+        out = decompress_bucket(wire, dtype=np.float32)
+    if out.size != n:
+        raise ValueError(f"delta length {out.size} != layout n {n}")
+    return out
+
+
+def delta_nbytes(wire: Any) -> int:
+    """Wire size of an encoded delta (payload + scales; raw bytes for off)."""
+    if isinstance(wire, (bytes, bytearray, memoryview)):
+        return len(wire)
+    return int(wire.payload.nbytes + wire.scales.nbytes)
+
+
+def answer_from_flat(flat: Optional[np.ndarray], seed: int) -> Optional[float]:
+    """Deterministic toy inference: a strided dot over the parameter
+    vector.  Pure function of (params, seed) so two workers at the same
+    snapshot version answer bit-identically — the convergence check the
+    chaos soak and bench both lean on."""
+    if flat is None or flat.size == 0:
+        return None
+    n = int(flat.size)
+    k = min(128, n)
+    start = (int(seed) * 2654435761) % max(1, n - k + 1)
+    window = flat[start : start + k].astype(np.float64)
+    weights = np.cos(np.arange(k, dtype=np.float64) * 0.1)
+    return float(np.dot(window, weights))
+
+
+def _json_body(handler: BaseHTTPRequestHandler) -> Dict[str, Any]:
+    length = int(handler.headers.get("Content-Length", 0) or 0)
+    raw = handler.rfile.read(length) if length else b"{}"
+    return json.loads(raw.decode() or "{}")
+
+
+def _send_json(
+    handler: BaseHTTPRequestHandler, code: int, obj: Dict[str, Any]
+) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _send_bytes(handler: BaseHTTPRequestHandler, body: bytes) -> None:
+    handler.send_response(200)
+    handler.send_header("Content-Type", "application/octet-stream")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _http_json(
+    url: str,
+    payload: Optional[Dict[str, Any]] = None,
+    timeout: float = 5.0,
+) -> Tuple[int, Dict[str, Any]]:
+    """One JSON request; returns (status, body).  4xx bodies are parsed,
+    not raised — the registry speaks structured 409s."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url,
+        data=data,
+        method="POST" if data is not None else "GET",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode() or "{}")
+        except Exception:  # noqa: BLE001
+            return e.code, {}
+
+
+# --------------------------------------------------------------------------
+# SnapshotRegistry — lives next to the lighthouse, health-gates routing
+# --------------------------------------------------------------------------
+class SnapshotRegistry:
+    """Tracks which replicas can serve which snapshot version and orders
+    them for workers, draining unhealthy sources first.
+
+    Stale-instance protection reuses the aggregator ``(epoch, seq)``
+    pattern: each registry instance mints a fresh ``epoch`` at startup;
+    announcements carry the epoch the publisher registered under plus a
+    per-publisher monotonic ``seq``.  After a registry (lighthouse)
+    restart every old announcement is rejected with 409 ``stale_epoch``
+    until the publisher re-registers — a replayed or delayed announce can
+    never resurrect pre-restart state."""
+
+    def __init__(
+        self,
+        lighthouse_addr: Optional[str] = None,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        drain_on: str = "warn",
+        poll_s: float = 0.25,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ) -> None:
+        if drain_on not in _DRAIN_POLICIES:
+            raise ValueError(
+                f"drain_on must be one of {_DRAIN_POLICIES}, got {drain_on!r}"
+            )
+        self._lock = threading.Lock()
+        self.epoch = uuid.uuid4().hex[:12]
+        self._drain_on = drain_on
+        self._poll_s = poll_s
+        self._lighthouse_addr = lighthouse_addr
+        self._health_fn = health_fn
+        # replica_id -> {version, seq, full_url, delta_url, chain, ...}
+        self._sources: Dict[str, Dict[str, Any]] = {}
+        self._registered: Dict[str, str] = {}  # replica_id -> epoch granted
+        self._drained_health: Dict[str, str] = {}  # replica_id -> state name
+        self._drained_manual: set = set()
+        self._counters: Dict[str, int] = {
+            "announce_total": 0,
+            "announce_rejected_total": 0,
+            "drain_transitions_total": 0,
+        }
+        self._metrics = MetricsRegistry()
+        self._stop = threading.Event()
+
+        registry = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("serve_registry: " + fmt, *args)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    path = self.path.partition("?")[0]
+                    if path == "/serve/sources":
+                        _send_json(self, 200, registry.sources())
+                    elif path == "/serve/status":
+                        _send_json(self, 200, registry.status())
+                    elif path in ("/metrics", "/"):
+                        registry._refresh_metrics()
+                        body = registry._metrics.render().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "text/plain; version=0.0.4"
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self.send_error(404)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("serve_registry GET failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_POST(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    path = self.path.partition("?")[0]
+                    body = _json_body(self)
+                    if path == "/serve/register":
+                        code, resp = registry.register(str(body["replica_id"]))
+                    elif path == "/serve/announce":
+                        code, resp = registry.announce(body)
+                    elif path == "/serve/drain":
+                        code, resp = registry.drain(
+                            str(body["replica_id"]),
+                            bool(body.get("drain", True)),
+                        )
+                    else:
+                        self.send_error(404)
+                        return
+                    _send_json(self, code, resp)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("serve_registry POST failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name="torchft_serve_registry",
+        )
+        self._serve_thread.start()
+        self._poll_thread: Optional[threading.Thread] = None
+        if lighthouse_addr or health_fn is not None:
+            self._poll_thread = threading.Thread(
+                target=self._health_poll_loop,
+                daemon=True,
+                name="torchft_serve_registry_health",
+            )
+            self._poll_thread.start()
+
+    # -- public api --------------------------------------------------------
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def register(self, replica_id: str) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            self._registered[replica_id] = self.epoch
+            return 200, {"epoch": self.epoch}
+
+    def announce(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        try:
+            replica_id = str(body["replica_id"])
+            epoch = str(body["epoch"])
+            seq = int(body["seq"])
+            version: Version = (int(body["quorum_id"]), int(body["step"]))
+            full_url = str(body["full_url"])
+            delta_url = str(body["delta_url"])
+            chain = str(body["chain"])
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": f"malformed announce: {e}"}
+        with self._lock:
+            self._counters["announce_total"] += 1
+            if epoch != self.epoch:
+                # pre-restart publisher: force a re-register handshake so
+                # stale announcements can't resurrect old state
+                self._counters["announce_rejected_total"] += 1
+                return 409, {"error": "stale_epoch", "epoch": self.epoch}
+            prior = self._sources.get(replica_id)
+            if prior is not None and seq <= prior["seq"]:
+                self._counters["announce_rejected_total"] += 1
+                return 409, {"error": "stale_seq", "have_seq": prior["seq"]}
+            if prior is not None and version <= tuple(prior["version"]):
+                # snapshot versions are strictly monotone per replica —
+                # a reconfigure bumps quorum_id, never rewinds the pair
+                self._counters["announce_rejected_total"] += 1
+                return 409, {
+                    "error": "stale_version",
+                    "have": list(prior["version"]),
+                }
+            self._sources[replica_id] = {
+                "version": list(version),
+                "seq": seq,
+                "full_url": full_url,
+                "delta_url": delta_url,
+                "chain": chain,
+                "announced_at": time.time(),
+            }
+            return 200, {"ok": True, "latest": self._latest_locked()}
+
+    def drain(self, replica_id: str, drain: bool) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            before = replica_id in self._drained_manual
+            if drain:
+                self._drained_manual.add(replica_id)
+            else:
+                self._drained_manual.discard(replica_id)
+            if before != drain:
+                self._counters["drain_transitions_total"] += 1
+            return 200, {"ok": True, "draining": sorted(self._all_drained())}
+
+    def forget(self, replica_id: str) -> None:
+        with self._lock:
+            self._sources.pop(replica_id, None)
+            self._registered.pop(replica_id, None)
+
+    def sources(self) -> Dict[str, Any]:
+        """Ordered source list for workers: healthy sources first (newest
+        version wins ties), drained sources kept at the TAIL — a fully
+        drained fleet still serves rather than failing requests."""
+        with self._lock:
+            drained = self._all_drained()
+            entries = []
+            for rid, src in self._sources.items():
+                entries.append(
+                    {
+                        "replica_id": rid,
+                        "version": list(src["version"]),
+                        "full_url": src["full_url"],
+                        "delta_url": src["delta_url"],
+                        "chain": src["chain"],
+                        "draining": rid in drained,
+                    }
+                )
+            entries.sort(
+                key=lambda e: (
+                    e["draining"],
+                    [-e["version"][0], -e["version"][1]],
+                    e["replica_id"],
+                )
+            )
+            latest = self._latest_locked()
+            chain = None
+            if latest is not None:
+                for e in entries:
+                    if e["version"] == latest:
+                        chain = e["chain"]
+                        break
+            return {
+                "epoch": self.epoch,
+                "latest": latest,
+                "chain": chain,
+                "sources": entries,
+                "draining": sorted(drained),
+            }
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "drain_on": self._drain_on,
+                "sources": dict(self._sources),
+                "drained_health": dict(self._drained_health),
+                "drained_manual": sorted(self._drained_manual),
+                "counters": dict(self._counters),
+            }
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+    # -- internals ---------------------------------------------------------
+    def _all_drained(self) -> set:
+        return set(self._drained_health) | self._drained_manual
+
+    def _latest_locked(self) -> Optional[List[int]]:
+        best: Optional[List[int]] = None
+        drained = self._all_drained()
+        pool = [
+            src["version"]
+            for rid, src in self._sources.items()
+            if rid not in drained
+        ] or [src["version"] for src in self._sources.values()]
+        for v in pool:
+            if best is None or tuple(v) > tuple(best):
+                best = v
+        return best
+
+    def _health_poll_loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                health = self._poll_health()
+            except Exception:  # noqa: BLE001 — keep serving on poll failure
+                logger.debug("serve_registry health poll failed", exc_info=True)
+                continue
+            if health is None:
+                continue
+            self.apply_health(health)
+
+    def _poll_health(self) -> Optional[Dict[str, Any]]:
+        if self._health_fn is not None:
+            return self._health_fn()
+        from .coordination import LighthouseClient  # lazy: avoid import cycle
+
+        assert self._lighthouse_addr is not None
+        return LighthouseClient(
+            self._lighthouse_addr, connect_timeout=2.0
+        ).health()
+
+    def apply_health(self, health: Dict[str, Any]) -> None:
+        """Fold one /health summary into the drain set.  Split out from the
+        poll loop so tests can drive escalations deterministically."""
+        from .healthwatch import serving_eligible
+
+        replicas = health.get("replicas", {}) or {}
+        with self._lock:
+            next_drained: Dict[str, str] = {}
+            for rid, info in replicas.items():
+                state = info.get("state", "ok")
+                if not serving_eligible(state, drain_on=self._drain_on):
+                    next_drained[rid] = str(state)
+            # replicas the lighthouse has excluded may vanish from the
+            # replicas map entirely; keep them drained
+            for rid in health.get("excluded", []) or []:
+                next_drained.setdefault(str(rid), "excluded")
+            if set(next_drained) != set(self._drained_health):
+                self._counters["drain_transitions_total"] += 1
+                logger.info(
+                    "serve_registry drain set -> %s", sorted(next_drained)
+                )
+            self._drained_health = next_drained
+
+    def _refresh_metrics(self) -> None:
+        with self._lock:
+            drained = self._all_drained()
+            latest = self._latest_locked()
+            n_sources = len(self._sources)
+            counters = dict(self._counters)
+        m = self._metrics
+        m.gauge_set(
+            "serve_draining", float(len(drained)),
+            "Sources currently drained from the serving set."
+        )
+        m.gauge_set(
+            "serve_sources", float(n_sources),
+            "Sources announced to the snapshot registry."
+        )
+        m.gauge_set(
+            "serve_latest_step",
+            float(latest[1]) if latest else -1.0,
+            "Step of the newest announced snapshot.",
+        )
+        for name, val in counters.items():
+            m.counter_set(f"serve_registry_{name}", float(val))
+
+
+# --------------------------------------------------------------------------
+# RegistryClient — retrying JSON client used by publishers and workers
+# --------------------------------------------------------------------------
+class RegistryClient:
+    """Thin retrying client for the registry's JSON API.
+
+    Transport errors retry under the standard TORCHFT_RETRY_* policy;
+    structured 4xx answers (stale_epoch & friends) are returned to the
+    caller, not retried — they are protocol, not weather."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 5.0,
+        retry_policy: Optional[RetryPolicy] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self._timeout = timeout
+        self._policy = (
+            retry_policy if retry_policy is not None else RetryPolicy.from_env()
+        )
+
+    def _call(
+        self, path: str, payload: Optional[Dict[str, Any]] = None
+    ) -> Tuple[int, Dict[str, Any]]:
+        def attempt(remaining: float) -> Tuple[int, Dict[str, Any]]:
+            return _http_json(
+                f"{self.base_url}{path}",
+                payload,
+                timeout=min(self._timeout, max(remaining, 0.05)),
+            )
+
+        return retry_call(
+            attempt,
+            policy=self._policy,
+            timeout=self._timeout,
+            retryable=(OSError, TimeoutError, ConnectionError, ValueError),
+        )
+
+    def register(self, replica_id: str) -> str:
+        code, resp = self._call("/serve/register", {"replica_id": replica_id})
+        if code != 200:
+            raise RuntimeError(f"register failed: {code} {resp}")
+        return str(resp["epoch"])
+
+    def announce(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        return self._call("/serve/announce", body)
+
+    def sources(self) -> Dict[str, Any]:
+        code, resp = self._call("/serve/sources")
+        if code != 200:
+            raise RuntimeError(f"sources failed: {code} {resp}")
+        return resp
+
+    def drain(self, replica_id: str, drain: bool = True) -> Dict[str, Any]:
+        code, resp = self._call(
+            "/serve/drain", {"replica_id": replica_id, "drain": drain}
+        )
+        if code != 200:
+            raise RuntimeError(f"drain failed: {code} {resp}")
+        return resp
+
+
+# --------------------------------------------------------------------------
+# SnapshotPublisher — rides the commit path on each live replica
+# --------------------------------------------------------------------------
+class SnapshotPublisher:
+    """Publishes versioned parameter snapshots from one training replica.
+
+    Full snapshots are staged on the existing checkpoint transport (the
+    same ranged/resumable wire heals ride); per-step deltas are encoded
+    once and retained in a ring of the last ``max_lag`` versions.  The
+    error-feedback reference ``R`` (class docstring above) is what full
+    pulls serve, so delta walks and full pulls are bitwise-identical."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        config: Optional[ServeConfig] = None,
+        registry_url: Optional[str] = None,
+        hostname: str = "127.0.0.1",
+    ) -> None:
+        from .checkpointing.http_transport import HTTPTransport
+
+        self.replica_id = replica_id
+        self.cfg = config if config is not None else ServeConfig.from_env()
+        url = registry_url if registry_url is not None else self.cfg.registry
+        self._registry = RegistryClient(url, timeout=self.cfg.timeout_s) if url else None
+        self._epoch: Optional[str] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._ref: Optional[np.ndarray] = None
+        self._version: Optional[Version] = None
+        self._layout: Optional[Dict[str, Any]] = None
+        self._chain: Optional[str] = None
+        self._deltas: "OrderedDict[Version, bytes]" = OrderedDict()
+        self.counters: Dict[str, int] = {
+            "published_total": 0,
+            "bootstrap_pulls_total": 0,
+            "announce_rejected_total": 0,
+            "delta_bytes_total": 0,
+        }
+        self._killed = False
+
+        # full snapshots ride the resumable checkpoint transport verbatim
+        self._transport = HTTPTransport(
+            timeout=self.cfg.timeout_s, hostname=hostname
+        )
+
+        publisher = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("serve_publisher: " + fmt, *args)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    path = self.path.partition("?")[0]
+                    parts = path.strip("/").split("/")
+                    # /serve/delta/{quorum_id}/{step} | /serve/manifest
+                    if parts[:2] == ["serve", "manifest"]:
+                        _send_json(self, 200, publisher.manifest())
+                        return
+                    if len(parts) == 4 and parts[:2] == ["serve", "delta"]:
+                        version = (int(parts[2]), int(parts[3]))
+                        action = _fire_fault(
+                            "delta_request",
+                            {
+                                "replica_id": publisher.replica_id,
+                                "version": version,
+                            },
+                        )
+                        if action == "die":
+                            self.close_connection = True
+                            return
+                        blob = publisher.delta_blob(version)
+                        if blob is None:
+                            self.send_error(404, "delta not retained")
+                            return
+                        _send_bytes(self, blob)
+                        return
+                    self.send_error(404)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("serve_publisher GET failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._delta_server = ThreadingHTTPServer((hostname, 0), _Handler)
+        self._delta_server.daemon_threads = True
+        self._delta_thread = threading.Thread(
+            target=self._delta_server.serve_forever,
+            daemon=True,
+            name="torchft_serve_publisher",
+        )
+        self._delta_thread.start()
+
+        # async publish: the commit path hands off a host copy and returns
+        self._queue_lock = threading.Lock()
+        self._queue_item: Optional[Tuple[int, int, np.ndarray, Dict[str, Any]]] = None
+        self._queue_event = threading.Event()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._publish_loop, daemon=True,
+            name="torchft_serve_publish",
+        )
+        self._worker.start()
+
+    # -- addresses ---------------------------------------------------------
+    @property
+    def full_url(self) -> str:
+        return self._transport.metadata()
+
+    @property
+    def delta_url(self) -> str:
+        host, port = self._delta_server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def version(self) -> Optional[Version]:
+        with self._lock:
+            return self._version
+
+    @property
+    def chain(self) -> Optional[str]:
+        with self._lock:
+            return self._chain
+
+    def ref_flat(self) -> Optional[np.ndarray]:
+        with self._lock:
+            return None if self._ref is None else self._ref.copy()
+
+    def manifest(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "chain": self._chain,
+                "mode": self.cfg.compress,
+                "version": list(self._version) if self._version else None,
+                "layout_sig": self._layout["sig"] if self._layout else None,
+                "deltas": [list(v) for v in self._deltas.keys()],
+            }
+
+    def delta_blob(self, version: Version) -> Optional[bytes]:
+        with self._lock:
+            return self._deltas.get(tuple(version))
+
+    # -- publishing --------------------------------------------------------
+    def publish(self, quorum_id: int, step: int, params: Any) -> Optional[Version]:
+        """Synchronously publish one committed snapshot.  Returns the
+        published version, or None when the version was already covered
+        (another replica got there first after a bootstrap)."""
+        flat, layout = flatten_params(params)
+        return self._publish_flat(int(quorum_id), int(step), flat, layout)
+
+    def publish_async(self, quorum_id: int, step: int, params: Any) -> None:
+        """Commit-path entry: snapshot the params to host NOW (so the next
+        step cannot tear them), encode+announce on the publisher thread.
+        Keeps only the newest pending item — the delta chain's ``prev``
+        pointers make skipped versions safe for delta walkers."""
+        flat, layout = flatten_params(params)
+        with self._queue_lock:
+            self._queue_item = (int(quorum_id), int(step), flat, layout)
+        self._queue_event.set()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Wait until the async queue is drained (tests/benches)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._queue_lock:
+                idle = self._queue_item is None
+            if idle and not self._queue_event.is_set():
+                return True
+            time.sleep(0.005)
+        return False
+
+    def _publish_loop(self) -> None:
+        while not self._stop.is_set():
+            self._queue_event.wait(0.1)
+            if self._stop.is_set():
+                return
+            with self._queue_lock:
+                item = self._queue_item
+                self._queue_item = None
+                if item is None:
+                    self._queue_event.clear()
+                    continue
+            try:
+                self._publish_flat(*item)
+            except Exception:  # noqa: BLE001 — advisory plane must not die
+                logger.exception("async snapshot publish failed")
+
+    def _publish_flat(
+        self,
+        quorum_id: int,
+        step: int,
+        flat: np.ndarray,
+        layout: Dict[str, Any],
+    ) -> Optional[Version]:
+        version: Version = (quorum_id, step)
+        with self._lock:
+            if self._killed:
+                return None
+            if self._layout is not None and layout["sig"] != self._layout["sig"]:
+                # model surgery: deltas cannot bridge layouts — reset the
+                # chain, workers will full-pull
+                logger.warning(
+                    "parameter layout changed (%s -> %s); resetting serve chain",
+                    self._layout["sig"], layout["sig"],
+                )
+                self._ref = None
+                self._version = None
+                self._chain = None
+                self._deltas.clear()
+            self._layout = layout
+
+        # a publisher that is behind the registry (fresh, healed, or it
+        # missed commits while ejected) must re-seat its reference on the
+        # fleet's published state or its deltas would fork the chain
+        self._maybe_bootstrap(version, layout)
+
+        with self._lock:
+            if self._killed:
+                return None
+            if self._version is not None and version <= self._version:
+                return None  # already covered (bootstrap adopted >= version)
+            if self._chain is None:
+                # deterministic chain id: replicas racing to seed the chain
+                # from identical state mint identical ids, so either one's
+                # deltas extend the other's
+                self._chain = (
+                    f"{self.cfg.compress}-{layout['sig']}-{quorum_id}.{step}"
+                )
+                self._ref = np.zeros(layout["n"], dtype=np.float32)
+                self._version = None
+            assert self._ref is not None
+            prev = self._version
+            delta = flat - self._ref
+            wire = encode_delta(delta, self.cfg.compress)
+            decoded = decode_delta(wire, self.cfg.compress, layout["n"])
+            # replay our own decode: R_v = R_{v-1} + decode(delta_v) is the
+            # exact arithmetic every worker performs
+            new_ref = self._ref + decoded
+            record = {
+                "v": 1,
+                "chain": self._chain,
+                "quorum_id": quorum_id,
+                "step": step,
+                "prev": list(prev) if prev is not None else None,
+                "mode": self.cfg.compress,
+                "layout_sig": layout["sig"],
+                "n": layout["n"],
+                "wire": wire,
+            }
+            blob = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+            self._ref = new_ref
+            self._version = version
+            self._deltas[version] = blob
+            while len(self._deltas) > self.cfg.max_lag:
+                self._deltas.popitem(last=False)
+            self.counters["published_total"] += 1
+            self.counters["delta_bytes_total"] += delta_nbytes(record["wire"])
+            ref_to_stage = self._ref
+            meta = {
+                "quorum_id": quorum_id,
+                "step": step,
+                "chain": self._chain,
+                "mode": self.cfg.compress,
+                "layout": json.dumps(layout),
+            }
+
+        # stage the full snapshot on the heal transport (dst_ranks=[]: the
+        # serving window is pull-based and never force-closed here)
+        self._transport.send_checkpoint(
+            dst_ranks=[],
+            step=step,
+            state_dict={"flat": ref_to_stage, "meta": meta},
+            timeout=self.cfg.timeout_s,
+        )
+        self._announce(version)
+        _fire_fault(
+            "announce",
+            {
+                "replica_id": self.replica_id,
+                "version": version,
+                "publisher": self,
+            },
+        )
+        return version
+
+    def _maybe_bootstrap(self, version: Version, layout: Dict[str, Any]) -> None:
+        if self._registry is None:
+            return
+        try:
+            listing = self._registry.sources()
+        except Exception:  # noqa: BLE001 — registry down: publish standalone
+            logger.debug("registry sources unavailable", exc_info=True)
+            return
+        latest = listing.get("latest")
+        if latest is None:
+            return
+        latest_v: Version = (int(latest[0]), int(latest[1]))
+        with self._lock:
+            ours = self._version
+            chain = self._chain
+        if ours is not None and chain == listing.get("chain"):
+            if ours >= latest_v:
+                return  # we are the tip (or beyond): delta normally
+            if latest_v == version:
+                # a co-replica just published the version WE are about to
+                # publish, and nothing was published strictly between our
+                # ref and it — our delta is byte-identical to theirs (same
+                # prev, same committed params, same deterministic codec),
+                # so publishing extends the chain without re-seating
+                return
+        others = [
+            s for s in listing.get("sources", [])
+            if s["replica_id"] != self.replica_id
+        ]
+        if not others:
+            return  # registry only knows us; nothing to re-seat on
+        try:
+            flat, meta = pull_full_snapshot(
+                others, latest_v, timeout=self.cfg.timeout_s
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "serve bootstrap pull failed; starting a fresh chain",
+                exc_info=True,
+            )
+            with self._lock:
+                self._ref = None
+                self._version = None
+                self._chain = None
+                self._deltas.clear()
+            return
+        got_layout = json.loads(meta["layout"])
+        with self._lock:
+            if got_layout["sig"] != layout["sig"] or meta["mode"] != self.cfg.compress:
+                # incompatible fleet state: publish a fresh chain instead
+                self._ref = None
+                self._version = None
+                self._chain = None
+                self._deltas.clear()
+                return
+            self._ref = np.ascontiguousarray(flat, dtype=np.float32)
+            self._version = (int(meta["quorum_id"]), int(meta["step"]))
+            self._chain = meta["chain"]
+            self._deltas.clear()  # our old ring forked from a stale ref
+            self.counters["bootstrap_pulls_total"] += 1
+
+    def _announce(self, version: Version) -> None:
+        if self._registry is None:
+            return
+        for attempt in range(2):
+            try:
+                if self._epoch is None:
+                    self._epoch = self._registry.register(self.replica_id)
+                self._seq += 1
+                code, resp = self._registry.announce(
+                    {
+                        "replica_id": self.replica_id,
+                        "epoch": self._epoch,
+                        "seq": self._seq,
+                        "quorum_id": version[0],
+                        "step": version[1],
+                        "full_url": self.full_url,
+                        "delta_url": self.delta_url,
+                        "chain": self.chain,
+                    }
+                )
+            except Exception:  # noqa: BLE001 — registry down: serve anyway
+                logger.warning("snapshot announce failed", exc_info=True)
+                return
+            if code == 200:
+                return
+            if resp.get("error") == "stale_epoch" and attempt == 0:
+                # registry (lighthouse) restarted: re-register under the
+                # new epoch and replay the announce once
+                self._epoch = None
+                self._seq = 0
+                continue
+            self.counters["announce_rejected_total"] += 1
+            logger.info("announce rejected: %s", resp)
+            return
+
+    # -- lifecycle ---------------------------------------------------------
+    def kill(self) -> None:
+        """Chaos hook: die abruptly — both serve endpoints vanish, nothing
+        is deregistered (the registry learns via health/drain)."""
+        with self._lock:
+            self._killed = True
+        self._stop.set()
+        self._queue_event.set()
+        for srv in (self._delta_server,):
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self._transport.shutdown(wait=False)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def shutdown(self) -> None:
+        self.kill()
+
+
+# --------------------------------------------------------------------------
+# Full-pull client helper (shared by workers and bootstrapping publishers)
+# --------------------------------------------------------------------------
+def pull_full_snapshot(
+    sources: List[Dict[str, Any]],
+    version: Version,
+    timeout: float = 15.0,
+    on_event: Optional[Callable[..., None]] = None,
+) -> Tuple[np.ndarray, Dict[str, Any]]:
+    """Ranged, resumable, multi-source full pull of snapshot ``version``.
+
+    Rides ``HTTPTransport.recv_checkpoint_multi`` verbatim: byte-range
+    chunks, crc32 trailers, mid-transfer failover across the registry's
+    ordered source list.  Returns ``(flat_f32, meta)``; raises if every
+    source is exhausted."""
+    from .checkpointing.http_transport import HTTPTransport
+
+    if not sources:
+        raise RuntimeError("no snapshot sources available")
+    receiver = HTTPTransport(timeout=timeout, client_only=True)
+    pairs = [
+        (s["replica_id"], (lambda u=s["full_url"]: u)) for s in sources
+    ]
+    state = receiver.recv_checkpoint_multi(
+        pairs, step=version[1], timeout=timeout, on_event=on_event
+    )
+    timings = receiver.last_recv_timings()
+    flat = np.ascontiguousarray(state["flat"], dtype=np.float32)
+    meta = dict(state["meta"])
+    meta["_bytes"] = int(timings.total_bytes) if timings else flat.nbytes
+    meta["_failovers"] = int(timings.failovers) if timings else 0
+    got = (int(meta["quorum_id"]), int(meta["step"]))
+    if got < version:
+        raise RuntimeError(
+            f"stale full snapshot: asked {version}, sources serve {got}"
+        )
+    return flat, meta
+
+
+# --------------------------------------------------------------------------
+# ServeWorker — answers traffic from the last-applied snapshot
+# --------------------------------------------------------------------------
+class ServeWorker:
+    """One inference worker: pulls snapshots in the background, answers
+    ``/infer`` from the last-applied version under a local lock.
+
+    The request path never touches the network, so registry convergence,
+    source kills, and quorum reconfigurations cannot fail a request —
+    the worker just answers from the version it has."""
+
+    def __init__(
+        self,
+        registry_url: str,
+        config: Optional[ServeConfig] = None,
+        name: Optional[str] = None,
+        start: bool = True,
+    ) -> None:
+        self.cfg = config if config is not None else ServeConfig.from_env()
+        self.name = name or f"worker-{uuid.uuid4().hex[:6]}"
+        self._registry = RegistryClient(registry_url, timeout=self.cfg.timeout_s)
+        self._lock = threading.Lock()
+        self._flat: Optional[np.ndarray] = None
+        self._version: Optional[Version] = None
+        self._chain: Optional[str] = None
+        self._latest_seen: Optional[Version] = None
+        self.counters: Dict[str, int] = {
+            "requests_total": 0,
+            "full_pulls_total": 0,
+            "delta_pulls_total": 0,
+            "full_bytes_total": 0,
+            "delta_bytes_total": 0,
+            "pull_failovers_total": 0,
+            "pull_errors_total": 0,
+        }
+        self._metrics = MetricsRegistry()
+        self._stop = threading.Event()
+
+        worker = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("serve_worker: " + fmt, *args)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    raw_path, _, raw_query = self.path.partition("?")
+                    if raw_path == "/infer":
+                        q = urllib.parse.parse_qs(raw_query)
+                        seed = int(q.get("seed", ["0"])[0])
+                        _send_json(self, 200, worker.answer(seed))
+                    elif raw_path == "/status":
+                        _send_json(self, 200, worker.status())
+                    elif raw_path in ("/metrics", "/"):
+                        worker._refresh_metrics()
+                        body = worker._metrics.render().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "text/plain; version=0.0.4"
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self.send_error(404)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    # the request plane must answer, not error: fall back
+                    # to a minimal degraded body if even answer() raised
+                    logger.exception("serve_worker request failed")
+                    try:
+                        _send_json(self, 200, {"result": None, "error": str(e)})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", self.cfg.port), _Handler)
+        self._server.daemon_threads = True
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name=f"torchft_serve_{self.name}",
+        )
+        self._serve_thread.start()
+
+        self._pull_thread = threading.Thread(
+            target=self._pull_loop, daemon=True,
+            name=f"torchft_pull_{self.name}",
+        )
+        if start:
+            self._pull_thread.start()
+
+    # -- request path ------------------------------------------------------
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def version(self) -> Optional[Version]:
+        with self._lock:
+            return self._version
+
+    def params_flat(self) -> Optional[np.ndarray]:
+        with self._lock:
+            return None if self._flat is None else self._flat.copy()
+
+    def answer(self, seed: int) -> Dict[str, Any]:
+        with self._lock:
+            flat = self._flat
+            version = self._version
+            self.counters["requests_total"] += 1
+        return {
+            "result": answer_from_flat(flat, seed),
+            "version": list(version) if version else None,
+            "worker": self.name,
+        }
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "worker": self.name,
+                "version": list(self._version) if self._version else None,
+                "latest_seen": (
+                    list(self._latest_seen) if self._latest_seen else None
+                ),
+                "chain": self._chain,
+                "lag_steps": self._lag_locked(),
+                "counters": dict(self.counters),
+            }
+
+    def wait_version(self, version: Version, timeout: float = 10.0) -> bool:
+        """Block until the worker has applied ``version`` or newer."""
+        deadline = time.monotonic() + timeout
+        target = tuple(version)
+        while time.monotonic() < deadline:
+            v = self.version
+            if v is not None and tuple(v) >= target:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def _lag_locked(self) -> int:
+        if self._latest_seen is None:
+            return 0
+        if self._version is None:
+            return self._latest_seen[1] + 1
+        return max(0, self._latest_seen[1] - self._version[1])
+
+    # -- pull plane --------------------------------------------------------
+    def _pull_loop(self) -> None:
+        while not self._stop.wait(self.cfg.poll_s):
+            try:
+                self.pull_once()
+            except Exception:  # noqa: BLE001 — keep answering regardless
+                self.counters["pull_errors_total"] += 1
+                logger.debug("worker pull failed", exc_info=True)
+
+    def pull_once(self) -> bool:
+        """One poll+pull cycle; returns True when a new version applied.
+        Public so tests can drive the worker deterministically."""
+        _fire_fault("worker_pull", {"worker": self.name})
+        listing = self._registry.sources()
+        latest = listing.get("latest")
+        if latest is None:
+            return False
+        latest_v: Version = (int(latest[0]), int(latest[1]))
+        chain = listing.get("chain")
+        with self._lock:
+            self._latest_seen = latest_v
+            current = self._version
+            cur_chain = self._chain
+        if current is not None and current >= latest_v and cur_chain == chain:
+            return False
+        sources = [s for s in listing.get("sources", []) if s["chain"] == chain]
+        if not sources:
+            return False
+        need_full = (
+            current is None
+            or cur_chain != chain
+            or (latest_v[1] - current[1]) > self.cfg.max_lag
+        )
+        if not need_full:
+            applied = self._delta_walk(sources, current, latest_v, chain)
+            if applied:
+                return True
+            # chain gap (pruned ring / missed prev): fall back to full
+        return self._full_pull(sources, latest_v)
+
+    def _full_pull(self, sources: List[Dict[str, Any]], latest_v: Version) -> bool:
+        def on_event(kind: str, **fields: Any) -> None:
+            if kind == "heal_failover":
+                self.counters["pull_failovers_total"] += 1
+
+        flat, meta = pull_full_snapshot(
+            sources, latest_v, timeout=self.cfg.timeout_s, on_event=on_event
+        )
+        version: Version = (int(meta["quorum_id"]), int(meta["step"]))
+        with self._lock:
+            self._flat = flat
+            self._version = version
+            self._chain = meta["chain"]
+            self.counters["full_pulls_total"] += 1
+            self.counters["full_bytes_total"] += int(meta["_bytes"])
+        logger.info(
+            "%s full-pulled snapshot %s (%d bytes)",
+            self.name, version, int(meta["_bytes"]),
+        )
+        return True
+
+    def _delta_walk(
+        self,
+        sources: List[Dict[str, Any]],
+        current: Version,
+        latest_v: Version,
+        chain: str,
+    ) -> bool:
+        """Apply per-step deltas current→latest, failing over across
+        sources per fetch.  Deltas are chained by ``prev`` pointers (the
+        previously *published* version, which may skip steps), so the walk
+        asks each source's manifest which version extends ours."""
+        applied_any = False
+        guard = 0
+        while True:
+            guard += 1
+            if guard > 4 * self.cfg.max_lag + 8:
+                return applied_any  # defensive: malformed manifests
+            with self._lock:
+                cur = self._version
+            if cur is None or cur >= latest_v:
+                return applied_any
+            record = self._fetch_next_delta(sources, cur, chain)
+            if record is None:
+                return False  # gap: caller falls back to full pull
+            decoded = decode_delta(record["wire"], record["mode"], record["n"])
+            version: Version = (int(record["quorum_id"]), int(record["step"]))
+            with self._lock:
+                if self._version is None or tuple(record["prev"]) != self._version:
+                    return False  # raced: restart via full pull
+                self._flat = self._flat + decoded
+                self._version = version
+                self.counters["delta_pulls_total"] += 1
+                self.counters["delta_bytes_total"] += record["_bytes"]
+            applied_any = True
+
+    def _fetch_next_delta(
+        self,
+        sources: List[Dict[str, Any]],
+        current: Version,
+        chain: str,
+    ) -> Optional[Dict[str, Any]]:
+        """Find and fetch the delta whose ``prev`` pointer is ``current``,
+        trying each source in registry order (failover per fetch)."""
+        last_exc: Optional[Exception] = None
+        for src in sources:
+            base = src["delta_url"]
+            try:
+                with urllib.request.urlopen(
+                    f"{base}/serve/manifest", timeout=self.cfg.timeout_s
+                ) as r:
+                    manifest = json.loads(r.read().decode())
+                if manifest.get("chain") != chain:
+                    continue
+                # the publisher's ring is ordered oldest->newest; find the
+                # record that extends our version
+                versions = [tuple(v) for v in manifest.get("deltas", [])]
+                nxt = None
+                for v in versions:
+                    if v > tuple(current):
+                        blob_url = f"{base}/serve/delta/{v[0]}/{v[1]}"
+                        with urllib.request.urlopen(
+                            blob_url, timeout=self.cfg.timeout_s
+                        ) as r:
+                            blob = r.read()
+                        record = pickle.loads(blob)
+                        if (
+                            record.get("chain") == chain
+                            and record.get("prev") is not None
+                            and tuple(record["prev"]) == tuple(current)
+                        ):
+                            record["_bytes"] = len(blob)
+                            nxt = record
+                        break  # only the first version past ours can chain
+                if nxt is not None:
+                    return nxt
+            except Exception as e:  # noqa: BLE001 — next source
+                last_exc = e
+                self.counters["pull_failovers_total"] += 1
+                continue
+        if last_exc is not None:
+            logger.debug("delta fetch exhausted sources: %r", last_exc)
+        return None
+
+    def _refresh_metrics(self) -> None:
+        with self._lock:
+            version = self._version
+            lag = self._lag_locked()
+            counters = dict(self.counters)
+        m = self._metrics
+        m.gauge_set(
+            "serve_version",
+            float(version[1]) if version else -1.0,
+            "Step of the last-applied snapshot.",
+        )
+        m.gauge_set(
+            "serve_lag_steps", float(lag),
+            "Steps between the newest announced snapshot and the applied one.",
+        )
+        m.counter_set(
+            "serve_requests_total", float(counters["requests_total"]),
+            "Inference requests answered.",
+        )
+        for name in (
+            "full_pulls_total",
+            "delta_pulls_total",
+            "full_bytes_total",
+            "delta_bytes_total",
+            "pull_failovers_total",
+            "pull_errors_total",
+        ):
+            m.counter_set(f"serve_{name}", float(counters[name]))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if not self._pull_thread.is_alive():
+            self._pull_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+
+# --------------------------------------------------------------------------
+# CLI: python -m torchft_tpu.serving {worker|registry} ...
+# --------------------------------------------------------------------------
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m torchft_tpu.serving",
+        description="torchft_tpu serving plane (docs/serving.md)",
+    )
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    w = sub.add_parser("worker", help="run one inference worker")
+    w.add_argument(
+        "--registry", default=None,
+        help=f"registry URL (default: ${SERVE_REGISTRY_ENV})",
+    )
+    w.add_argument("--port", type=int, default=None, help="worker HTTP port")
+    w.add_argument("--name", default=None)
+
+    r = sub.add_parser("registry", help="run a standalone snapshot registry")
+    r.add_argument("--lighthouse", default=None, help="lighthouse host:port")
+    r.add_argument("--port", type=int, default=0)
+    r.add_argument("--drain-on", default=None, choices=_DRAIN_POLICIES)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if args.role == "worker":
+        cfg = ServeConfig.from_env(
+            registry=args.registry, port=args.port
+        )
+        if not cfg.registry:
+            parser.error(
+                f"--registry or ${SERVE_REGISTRY_ENV} is required for a worker"
+            )
+        worker = ServeWorker(cfg.registry, config=cfg, name=args.name)
+        print(json.dumps({"worker": worker.name, "url": worker.url}), flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            worker.shutdown()
+        return 0
+
+    cfg = ServeConfig.from_env(drain_on=args.drain_on)
+    registry = SnapshotRegistry(
+        lighthouse_addr=args.lighthouse,
+        drain_on=cfg.drain_on,
+        port=args.port,
+    )
+    print(json.dumps({"registry": registry.url, "epoch": registry.epoch}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        registry.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,383 @@
+"""Redundancy-plane recovery benchmark: parallel erasure reconstruct vs
+single-source heal, plus the steady-state cost of shard staging on the
+managed step. Prints ONE JSON line; full runs also write
+``BENCH_RECOVERY.json``.
+
+    python benchmarks/redundancy_bench.py [--smoke]
+
+NIC model (provenance — read before quoting numbers): this host is one
+1-vCPU loopback box, so raw socket throughput says nothing about a pod.
+Every ShardStore GET is sleep-throttled to ``--nic-mb-s`` per holder —
+the stand-in for per-peer NIC egress. A single-source heal drains ONE
+holder's egress cap serially; the parallel reconstruct drains k+m
+holders concurrently, so the transfer-bound expectation is ~k x at
+large sizes. What this host pays HONESTLY on top: crc32 verification,
+the GF(256) decode, and state unpack all run on the single vCPU and are
+included in the parallel wall-clock — the measured speedup is therefore
+a floor, not a cherry-pick. Absolute seconds are the model's, ratios
+are the claim.
+
+Phases:
+
+- **curve**: for each size, stage the same packed state twice — as one
+  k=1/m=0 whole-blob generation on one throttled holder (exactly the
+  single-source heal wire) and as a k/m erasure generation across k+m
+  throttled holders — then time ``reconstruct_state`` for each through
+  the same directory + shard-store path, asserting bitwise-identical
+  round-trips.
+- **staging**: the commit-path cost. ``ShardStager.stage()`` (the exact
+  call the Manager makes per commit: pack + newest-wins enqueue) is
+  timed across a simulated train loop, and a real 2-replica managed
+  fleet with redundancy ON measures the managed step gap it amortizes
+  against. Overhead percent = mean stage() wall / median step gap.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+FULL_SIZES_MB = (64, 256, 1024)
+SMOKE_SIZES_MB = (8,)
+
+
+def _make_state(size_mb: int, seed: int = 0) -> dict:
+    """One float32 leaf of ``size_mb`` built by tiling a 1 MiB random
+    block — fast to generate at 1 GB, non-degenerate for crc32."""
+    block = (
+        np.random.RandomState(seed)
+        .randint(0, 1 << 31, size=(1 << 18,), dtype=np.int64)
+        .astype(np.float32)
+    )
+    reps = max(1, (size_mb * (1 << 20)) // block.nbytes)
+    return {"w": np.tile(block, reps)}
+
+
+def _stage_generation(client, owner, step, blob, k, m, stores):
+    """Encode + PUT + announce one generation the way ShardStager does,
+    returning (encode_s, put_s)."""
+    from torchft_tpu.checkpointing.erasure import encode_shards, shard_crc
+    from torchft_tpu.redundancy import put_shard
+
+    t0 = time.monotonic()
+    shards = encode_shards(blob, k, m)
+    encode_s = time.monotonic() - t0
+    epoch = client.register(owner, pod="bench", store_url=stores[0].url)
+    entries = []
+    t0 = time.monotonic()
+    for idx, body in enumerate(shards):
+        store = stores[idx % len(stores)]
+        put_shard(store.url, owner, step, idx, body, timeout=600.0)
+        entries.append(
+            {
+                "idx": idx,
+                "holder": store.replica_id,
+                "url": store.url,
+                "crc": shard_crc(body),
+            }
+        )
+    put_s = time.monotonic() - t0
+    code, resp = client.announce(
+        {
+            "replica_id": owner,
+            "epoch": epoch,
+            "seq": 1,
+            "step": step,
+            "k": k,
+            "m": m,
+            "data_len": len(blob),
+            "shards": entries,
+        }
+    )
+    if code != 200:
+        raise RuntimeError(f"bench announce rejected: {resp}")
+    return encode_s, put_s
+
+
+def reconstruct_point(size_mb: int, k: int, m: int, nic_mb_s: float) -> dict:
+    """Single-source vs parallel reconstruct at one state size."""
+    from torchft_tpu.redundancy import (
+        DirectoryClient,
+        ShardDirectory,
+        ShardStore,
+        pack_state_blob,
+        reconstruct_state,
+    )
+
+    directory = ShardDirectory()
+    client = DirectoryClient(directory.url, timeout=30.0)
+    state = _make_state(size_mb)
+    blob = pack_state_blob(state)
+    single_store = ShardStore("bench_single_holder", throttle_mb_s=nic_mb_s)
+    par_stores = [
+        ShardStore(f"bench_holder_{i}", throttle_mb_s=nic_mb_s)
+        for i in range(k + m)
+    ]
+    try:
+        encode_s, _ = _stage_generation(
+            client, "bench_parallel", 1, blob, k, m, par_stores
+        )
+        _stage_generation(
+            client, "bench_single", 1, blob, 1, 0, [single_store]
+        )
+        # the stores hold their own shard copies now; drop the staging blob
+        # so neither timed leg pays for a bloated resident set
+        del blob
+
+        # each leg is timed, verified, then freed before the next leg runs:
+        # a real heal reconstructs into a fresh worker, so neither mode
+        # should be measured while a previous 1 GB result is pinned in RAM
+        # (on virtualized hosts, fresh-page faults slow down with footprint)
+        t0 = time.monotonic()
+        _, got_single, stats_single = reconstruct_state(
+            directory.url, owner="bench_single", timeout=1200.0,
+            max_workers=1,
+        )
+        single_s = time.monotonic() - t0
+        if not np.array_equal(np.asarray(got_single["w"]), state["w"]):
+            raise RuntimeError(
+                f"single reconstruct at {size_mb} MB is not bitwise-equal"
+            )
+        shards_ok_single = stats_single["shards_ok"]
+        del got_single, stats_single
+
+        t0 = time.monotonic()
+        _, got_par, stats_par = reconstruct_state(
+            directory.url, owner="bench_parallel", timeout=1200.0,
+            max_workers=k + m,
+        )
+        parallel_s = time.monotonic() - t0
+        if not np.array_equal(np.asarray(got_par["w"]), state["w"]):
+            raise RuntimeError(
+                f"parallel reconstruct at {size_mb} MB is not bitwise-equal"
+            )
+        shards_ok_parallel = stats_par["shards_ok"]
+        del got_par, stats_par
+    finally:
+        single_store.shutdown()
+        for s in par_stores:
+            s.shutdown()
+        directory.shutdown()
+
+    mb = size_mb
+    return {
+        "size_mb": size_mb,
+        "single_source_s": round(single_s, 3),
+        "parallel_s": round(parallel_s, 3),
+        "speedup_x": round(single_s / parallel_s, 2),
+        "single_source_mb_s": round(mb / single_s, 1),
+        "parallel_mb_s": round(mb / parallel_s, 1),
+        "encode_s": round(encode_s, 3),
+        "shards_ok_parallel": shards_ok_parallel,
+        "shards_ok_single": shards_ok_single,
+    }
+
+
+def _managed_step_gap(
+    state_mb: int, steps: int, compute_s: float, k: int, m: int,
+    interval: int,
+) -> float:
+    """Median inter-commit gap of a real 2-replica managed fleet with the
+    redundancy plane ON (stager attached, co-hosted directory)."""
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=2000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=2000,
+        redundancy_directory=True,
+    )
+    env_keys = {
+        "TORCHFT_REDUNDANCY_K": str(k),
+        "TORCHFT_REDUNDANCY_M": str(m),
+        "TORCHFT_REDUNDANCY_DIRECTORY": lh.redundancy_directory_url(),
+        "TORCHFT_REDUNDANCY_INTERVAL": str(interval),
+    }
+    saved = {kk: os.environ.get(kk) for kk in env_keys}
+    os.environ.update(env_keys)
+    n_elem = state_mb * (1 << 20) // 4
+    commit_times: list = []
+
+    def replica(rid: int) -> None:
+        params = {"w": np.zeros(n_elem, dtype=np.float32)}
+        manager = Manager(
+            pg=ProcessGroupHost(timeout=30.0),
+            load_state_dict=lambda sd: params.update(
+                w=np.asarray(sd["w"], dtype=np.float32)
+            ),
+            state_dict=lambda: {"w": params["w"]},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id=f"red_bench_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=30.0,
+            quorum_timeout=15.0,
+        )
+        grads = {"w": np.full(n_elem, 0.01, dtype=np.float32)}
+        try:
+            while manager.current_step() < steps:
+                manager.start_quorum()
+                time.sleep(compute_s)  # the simulated train step
+                avg = manager.allreduce(grads).get_future().wait(120)
+                if manager.should_commit():
+                    params["w"] = params["w"] - np.asarray(avg["w"])
+                    if rid == 0:
+                        commit_times.append(time.monotonic())
+        finally:
+            manager.shutdown(wait=False)
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [ex.submit(replica, r) for r in range(2)]
+            for f in futs:
+                f.result(timeout=600)
+    finally:
+        lh.shutdown()
+        for kk, v in saved.items():
+            if v is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = v
+    gaps = np.diff(commit_times)
+    if len(gaps) < 3:
+        raise RuntimeError("not enough commits for a step-gap estimate")
+    return float(np.median(gaps))
+
+
+def staging_overhead(
+    state_mb: int, steps: int, compute_s: float, k: int, m: int,
+    interval: int,
+) -> dict:
+    """Hot-path stage() cost amortized over the managed step."""
+    from torchft_tpu.redundancy import (
+        DirectoryClient,
+        RedundancyConfig,
+        ShardDirectory,
+        ShardStager,
+        ShardStore,
+    )
+
+    directory = ShardDirectory()
+    client = DirectoryClient(directory.url, timeout=10.0)
+    stores = [ShardStore(f"bench_peer_{i}") for i in range(k + m)]
+    for s in stores:
+        client.register(s.replica_id, pod="bench", store_url=s.url)
+    cfg = RedundancyConfig(
+        k=k, m=m, directory=directory.url, interval=interval
+    )
+    stager = ShardStager(cfg, "bench_stage_owner")
+    state = _make_state(state_mb, seed=1)
+    costs = []
+    try:
+        for step in range(1, steps + 1):
+            t0 = time.perf_counter()
+            stager.stage(step, state)
+            costs.append(time.perf_counter() - t0)
+            time.sleep(compute_s)
+        staged_to = stager.last_staged_step()
+    finally:
+        stager.shutdown()
+        for s in stores:
+            s.shutdown()
+        directory.shutdown()
+
+    step_gap_s = _managed_step_gap(
+        state_mb, steps=max(6, steps // 2), compute_s=compute_s,
+        k=k, m=m, interval=interval,
+    )
+    mean_stage_s = float(np.mean(costs))
+    return {
+        "staging_state_mb": state_mb,
+        "staging_interval": interval,
+        "stage_call_mean_s": round(mean_stage_s, 5),
+        "stage_call_max_s": round(float(np.max(costs)), 5),
+        "managed_step_s": round(step_gap_s, 4),
+        "staging_overhead_pct": round(100.0 * mean_stage_s / step_gap_s, 3),
+        # did the async worker keep pace with the commit cadence?
+        "staging_kept_up": bool(staged_to >= steps - 2 * interval),
+    }
+
+
+def run(smoke: bool, nic_mb_s: float) -> dict:
+    k, m = (4, 1) if smoke else (8, 2)
+    sizes = SMOKE_SIZES_MB if smoke else FULL_SIZES_MB
+    curve = [reconstruct_point(s, k, m, nic_mb_s) for s in sizes]
+    at_max = curve[-1]
+    if smoke:
+        overhead = staging_overhead(
+            state_mb=4, steps=6, compute_s=0.1, k=2, m=1, interval=2
+        )
+    else:
+        overhead = staging_overhead(
+            state_mb=64, steps=20, compute_s=0.8, k=2, m=1, interval=10
+        )
+    return {
+        "recovery_k": k,
+        "recovery_m": m,
+        "recovery_nic_mb_s": nic_mb_s,
+        "recovery_curve": curve,
+        "recovery_size_mb_at_max": at_max["size_mb"],
+        "recovery_single_source_s_at_max": at_max["single_source_s"],
+        "recovery_parallel_s_at_max": at_max["parallel_s"],
+        "recovery_reconstruct_speedup_x": at_max["speedup_x"],
+        **overhead,
+        "provenance": (
+            "1-vCPU loopback host; per-holder NIC egress modeled by "
+            f"sleep-throttling ShardStore GETs to {nic_mb_s} MB/s; crc32, "
+            "GF(256) decode and state unpack run serially on the one vCPU "
+            "and are included in the parallel wall-clock (speedup is a "
+            "floor). Absolute seconds are the model's; ratios are the "
+            "claim."
+        ),
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--nic-mb-s", type=float, default=40.0)
+    parser.add_argument(
+        "--out",
+        default=os.path.join(REPO_ROOT, "BENCH_RECOVERY.json"),
+        help="recovery-curve output path (full runs only; '-' disables)",
+    )
+    args = parser.parse_args(argv)
+
+    result = run(smoke=args.smoke, nic_mb_s=args.nic_mb_s)
+    if not args.smoke and args.out != "-":
+        with open(args.out, "w") as f:
+            json.dump(
+                {
+                    "bench": "redundancy plane (parallel reconstruct vs "
+                    "single-source heal)",
+                    "harness": "benchmarks/redundancy_bench.py",
+                    **result,
+                },
+                f,
+                indent=1,
+                sort_keys=True,
+            )
+            f.write("\n")
+        print(f"[redundancy_bench] wrote {args.out}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "parallel reconstruct speedup over single-source heal",
+        "value": result["recovery_reconstruct_speedup_x"],
+        "unit": "x",
+        "vs_baseline": result["recovery_reconstruct_speedup_x"],
+        **{kk: v for kk, v in result.items() if kk != "recovery_curve"},
+        "recovery_curve": result["recovery_curve"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

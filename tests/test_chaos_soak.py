@@ -857,6 +857,424 @@ def _run_soak_phase(rng, plane, transport_kind, mode, n_replicas,
 
 
 @pytest.mark.slow
+def test_hot_spare_swap_in_under_load_converges_bitwise():
+    """Redundancy-plane chaos phase (the tentpole's acceptance bar): the
+    fleet trains with erasure staging on (k=2, m=1) and live serving
+    traffic flowing; chaos kills a quorum member for good. The shard
+    directory's announce-gap detector presumes it dead, promotes the hot
+    spare (which has been prefetching every announced generation), the
+    spare joins the control plane via ``Manager.promote()`` and converges
+    — the bar is bitwise-equal params across survivors + the promoted
+    spare, ZERO lost steps (the committed frontier never regresses), and
+    ZERO failed serving requests through the death."""
+    import json as _json
+    import urllib.request
+
+    from torchft_tpu.serving import (
+        ServeConfig,
+        ServeWorker,
+        SnapshotPublisher,
+        SnapshotRegistry,
+    )
+
+    n_replicas = 3
+    target = 40
+    victim = 2
+    kill_after_commits = 8
+    step_sleep_s = 0.03
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800,
+        redundancy_directory=True,
+    )
+    directory_url = lh.redundancy_directory_url()
+    reg = SnapshotRegistry(lighthouse_addr=lh.address(), drain_on="warn")
+    cfg = ServeConfig(
+        registry=reg.url, max_lag=16, compress="off", poll_s=0.02,
+        drain_on="warn", timeout_s=5.0,
+    )
+
+    env_saved = {
+        k: os.environ.get(k)
+        for k in (
+            "TORCHFT_REDUNDANCY_K",
+            "TORCHFT_REDUNDANCY_M",
+            "TORCHFT_REDUNDANCY_DIRECTORY",
+        )
+    }
+    os.environ["TORCHFT_REDUNDANCY_K"] = "2"
+    os.environ["TORCHFT_REDUNDANCY_M"] = "1"
+    os.environ["TORCHFT_REDUNDANCY_DIRECTORY"] = directory_url
+
+    kill_flag = threading.Event()
+    fleet_done = threading.Event()
+    finals: dict = {}
+    fleet_max_step = [0]
+    mono_lock = threading.Lock()
+    commit_counts = {r: 0 for r in range(n_replicas)}
+    commit_counts["spare"] = 0
+    failure: list = []
+    pubs: dict = {}
+    spare_timings: dict = {}
+
+    def note_commit(rid, step: int, incarnation_last: int) -> None:
+        # zero lost steps: a replica never re-commits a step within one
+        # incarnation (no rollback), and the fleet-wide committed
+        # frontier only grows (loose proximity bound absorbs thread
+        # scheduling skew between commit and this bookkeeping)
+        assert step > incarnation_last, (rid, step, incarnation_last)
+        with mono_lock:
+            assert step >= fleet_max_step[0] - 12, (
+                f"step {step} fell behind fleet frontier {fleet_max_step[0]}"
+            )
+            fleet_max_step[0] = max(fleet_max_step[0], step)
+
+    def run_loop(rid, manager, params, grad_base) -> None:
+        zgrads = {"w": np.zeros(8, np.float32)}
+        incarnation_last = manager.current_step()
+        while manager.current_step() < target:
+            if rid == victim and kill_flag.is_set():
+                raise _Killed()
+            manager.start_quorum()
+            if manager.current_step() >= target:
+                manager.allreduce(zgrads).get_future().wait(30)
+                if manager.should_commit():
+                    break
+                continue
+            step = manager.current_step()
+            time.sleep(step_sleep_s)
+            g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+            avg = manager.allreduce({"w": g}).get_future().wait(30)
+            if manager.should_commit():
+                committed = manager.current_step()
+                note_commit(rid, committed, incarnation_last)
+                incarnation_last = committed
+                params["w"] = (
+                    params["w"] - LR * np.asarray(avg["w"])
+                ).astype(np.float32)
+                commit_counts[rid] += 1
+        finals[rid] = params["w"].copy()
+        with mono_lock:
+            if len(finals) == n_replicas:
+                fleet_done.set()
+        while not fleet_done.is_set():
+            manager.start_quorum()
+            manager.allreduce(zgrads).get_future().wait(30)
+            manager.should_commit()
+
+    def replica(rid: int) -> None:
+        grad_base = np.random.RandomState(800 + rid).randn(8).astype(
+            np.float32
+        )
+        params = {"w": np.zeros(8, np.float32)}
+
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        manager = Manager(
+            pg=ProcessGroupHost(timeout=8.0),
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id=f"redsoak_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=8.0,
+            quorum_timeout=4.0,
+            heartbeat_interval=0.02,
+        )
+        pub = SnapshotPublisher(
+            f"redsoak_{rid}", config=cfg, registry_url=reg.url
+        )
+        pubs[rid] = pub
+        manager.attach_serve_publisher(
+            pub, params_fn=lambda: {"w": params["w"]}
+        )
+        try:
+            run_loop(rid, manager, params, grad_base)
+        except _Killed:
+            pass  # permanent death: the spare replaces this member
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+            pub.shutdown()
+
+    def spare() -> None:
+        grad_base = np.random.RandomState(990).randn(8).astype(np.float32)
+        params = {"w": np.zeros(8, np.float32)}
+
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        manager = Manager(
+            pg=ProcessGroupHost(timeout=8.0),
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id="redsoak_spare",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=8.0,
+            quorum_timeout=4.0,
+            heartbeat_interval=0.02,
+            spare=True,
+        )
+        try:
+            promotion = manager.promote(timeout=90.0)
+            assert promotion.get("replaces", "").startswith(
+                f"redsoak_{victim}"
+            ), promotion
+            run_loop("spare", manager, params, grad_base)
+            spare_timings.update(manager.timings())
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+
+    worker = ServeWorker(reg.url, config=cfg, name="redsoak_w0")
+    stop_traffic = threading.Event()
+    serve_failures: list = []
+    ok_requests = [0]
+
+    def loadgen() -> None:
+        # don't count requests before the first snapshot lands — the
+        # zero-failures bar starts once the plane is serving
+        first = time.monotonic() + 60.0
+        while (worker.version is None and not stop_traffic.is_set()
+               and time.monotonic() < first):
+            time.sleep(0.02)
+        seed = 0
+        while not stop_traffic.is_set():
+            seed += 1
+            try:
+                with urllib.request.urlopen(
+                    f"{worker.url}/infer?seed={seed}", timeout=5.0
+                ) as r:
+                    resp = _json.loads(r.read().decode())
+                    if r.status != 200 or resp.get("result") is None:
+                        serve_failures.append(("bad", r.status, resp))
+                        continue
+                ok_requests[0] += 1
+            except Exception as e:  # noqa: BLE001
+                serve_failures.append(("exc", repr(e)))
+            time.sleep(0.002)
+
+    ex = ThreadPoolExecutor(max_workers=n_replicas + 2)
+    try:
+        futs = [ex.submit(replica, r) for r in range(n_replicas)]
+        futs.append(ex.submit(spare))
+        traffic_fut = ex.submit(loadgen)
+        deadline = time.monotonic() + 240.0
+        while not fleet_done.is_set() and time.monotonic() < deadline:
+            if failure:
+                break
+            if (not kill_flag.is_set()
+                    and commit_counts[victim] >= kill_after_commits):
+                kill_flag.set()
+            time.sleep(0.05)
+        for f in futs:
+            f.result(timeout=max(5.0, deadline - time.monotonic()))
+    finally:
+        fleet_done.set()
+        stop_traffic.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        worker.shutdown()
+        reg.shutdown()
+        lh.shutdown()
+        for k, v in env_saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    assert not failure, failure
+    # the spare finished the victim's seat: survivors + spare, all bitwise
+    assert set(finals) == {0, 1, "spare"}, finals.keys()
+    np.testing.assert_array_equal(
+        finals[0], finals[1], err_msg="survivors diverged"
+    )
+    np.testing.assert_array_equal(
+        finals[0], finals["spare"],
+        err_msg="promoted spare diverged from survivors",
+    )
+    assert np.isfinite(finals[0]).all()
+    assert fleet_max_step[0] >= target
+    # the spare actually rode the redundancy plane in (prefetch and/or
+    # reconstruct-heal), not a cold join
+    assert spare_timings.get("spare_promote_step", -1.0) >= 0.0, spare_timings
+    # zero failed serving requests through the member death
+    assert not serve_failures, (
+        f"{len(serve_failures)} failed serving requests "
+        f"(first: {serve_failures[:3]}); {ok_requests[0]} succeeded"
+    )
+    assert ok_requests[0] > 50, ok_requests[0]
+
+
+@pytest.mark.slow
+def test_reconstruct_with_one_corrupt_shard_repairs():
+    """Redundancy-plane corrupt-shard phase: every shard-store GET of
+    shard 0 serves a flipped byte (``EventInjector.corrupt_shard`` armed
+    for every owner, every serve). A killed-and-restarted replica heals
+    through the parallel reconstruct path: crc32 flags the corrupt slot,
+    per-shard failover marks it missing, and parity (k=2, m=1) repairs
+    the payload — the fleet still converges bitwise and the victim's
+    counters show the detect+repair actually happened."""
+    from torchft_tpu._test.event_injector import EventInjector
+
+    n_replicas = 3
+    target = 30
+    victim = 2
+    kill_after_commits = 6
+    step_sleep_s = 0.05
+
+    injector = EventInjector()
+    # every owner's shard 0 is corrupt on EVERY serve: whichever
+    # generation the healing replica reconstructs, the crc gate must fire
+    injector.corrupt_shard("redcorrupt_", 0, times=-1)
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800,
+        redundancy_directory=True,
+    )
+    env_saved = {
+        k: os.environ.get(k)
+        for k in (
+            "TORCHFT_REDUNDANCY_K",
+            "TORCHFT_REDUNDANCY_M",
+            "TORCHFT_REDUNDANCY_DIRECTORY",
+        )
+    }
+    os.environ["TORCHFT_REDUNDANCY_K"] = "2"
+    os.environ["TORCHFT_REDUNDANCY_M"] = "1"
+    os.environ["TORCHFT_REDUNDANCY_DIRECTORY"] = (
+        lh.redundancy_directory_url()
+    )
+
+    kill_flag = threading.Event()
+    fleet_done = threading.Event()
+    finals: dict = {}
+    commit_counts = {r: 0 for r in range(n_replicas)}
+    victim_timings: dict = {}
+    failure: list = []
+
+    def replica(rid: int) -> None:
+        grad_base = np.random.RandomState(870 + rid).randn(8).astype(
+            np.float32
+        )
+        incarnation = 0
+        while True:
+            incarnation += 1
+            params = {"w": np.zeros(8, np.float32)}
+
+            def load(sd, params=params):
+                params["w"] = np.array(
+                    np.asarray(sd["w"]), dtype=np.float32
+                )
+
+            manager = Manager(
+                pg=ProcessGroupHost(timeout=8.0),
+                load_state_dict=load,
+                state_dict=lambda params=params: {"w": params["w"].copy()},
+                min_replica_size=1,
+                use_async_quorum=True,
+                replica_id=f"redcorrupt_{rid}",
+                lighthouse_addr=f"127.0.0.1:{lh.port}",
+                timeout=8.0,
+                quorum_timeout=4.0,
+                heartbeat_interval=0.02,
+            )
+            zgrads = {"w": np.zeros(8, np.float32)}
+            died = False
+            try:
+                while manager.current_step() < target:
+                    if rid == victim and kill_flag.is_set():
+                        kill_flag.clear()
+                        raise _Killed()
+                    manager.start_quorum()
+                    if manager.current_step() >= target:
+                        manager.allreduce(zgrads).get_future().wait(30)
+                        if manager.should_commit():
+                            break
+                        continue
+                    step = manager.current_step()
+                    time.sleep(step_sleep_s)
+                    g = (grad_base * (1.0 + 0.01 * step)).astype(
+                        np.float32
+                    )
+                    avg = manager.allreduce(
+                        {"w": g}
+                    ).get_future().wait(30)
+                    if manager.should_commit():
+                        params["w"] = (
+                            params["w"] - LR * np.asarray(avg["w"])
+                        ).astype(np.float32)
+                        commit_counts[rid] += 1
+                finals[rid] = params["w"].copy()
+                if rid == victim:
+                    victim_timings.update(manager.timings())
+                if len(finals) == n_replicas:
+                    fleet_done.set()
+                while not fleet_done.is_set():
+                    manager.start_quorum()
+                    manager.allreduce(zgrads).get_future().wait(30)
+                    manager.should_commit()
+                return
+            except _Killed:
+                died = True
+            except BaseException as e:  # noqa: BLE001
+                failure.append(e)
+                raise
+            finally:
+                manager.shutdown(wait=False)
+            if died:
+                time.sleep(0.3)  # let the fleet advance so the rejoin heals
+
+    ex = ThreadPoolExecutor(max_workers=n_replicas)
+    try:
+        futs = [ex.submit(replica, r) for r in range(n_replicas)]
+        deadline = time.monotonic() + 240.0
+        killed = False
+        while not fleet_done.is_set() and time.monotonic() < deadline:
+            if failure:
+                break
+            if not killed and commit_counts[victim] >= kill_after_commits:
+                killed = True
+                kill_flag.set()
+            time.sleep(0.05)
+        for f in futs:
+            f.result(timeout=max(5.0, deadline - time.monotonic()))
+    finally:
+        fleet_done.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        injector.clear_redundancy_faults()
+        lh.shutdown()
+        for k, v in env_saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    assert not failure, failure
+    assert set(finals) == set(range(n_replicas)), finals.keys()
+    for rid in range(1, n_replicas):
+        np.testing.assert_array_equal(
+            finals[0], finals[rid],
+            err_msg=f"replica {rid} diverged across the corrupt-shard heal",
+        )
+    assert np.isfinite(finals[0]).all()
+    # the corrupt shard was SERVED (hook fired), DETECTED (crc counter),
+    # and REPAIRED (the reconstruct still completed)
+    assert injector.count >= 1, "armed corruption never fired"
+    assert victim_timings.get("shard_corrupt", 0.0) >= 1.0, victim_timings
+    assert victim_timings.get("reconstructs", 0.0) >= 1.0, victim_timings
+
+
+@pytest.mark.slow
 def test_serving_kill_mid_traffic_drains_and_converges():
     """Serving-plane chaos phase: live traffic runs against two workers
     while the fleet publishes a snapshot every ~50 ms; the injector kills

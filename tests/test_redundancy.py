@@ -1,0 +1,544 @@
+"""Redundancy-plane protocol tests (redundancy.py, docs/operations.md).
+
+Three contracts are pinned here, one per layer:
+
+* the ShardDirectory's (epoch, seq, step) staleness matrix — a replayed,
+  delayed, or pre-restart announce is rejected with a structured 409 and
+  never merged, and spare promotion is monotonic (each promotion gets the
+  next promote_seq, a spare is never un-promoted, a dead owner is never
+  promoted onto twice);
+* the shard wire — pod-aware placement, ranged/resumable pulls with a
+  streaming crc32, and per-shard failover in the parallel reconstruct
+  (any k surviving shards decode bitwise);
+* the Manager's k=0 pin — with redundancy off (the default), the heal
+  path never touches the reconstruct branch, so every existing path
+  stays byte-identical (manager.py references this test by name).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing.erasure import encode_shards, shard_crc
+from torchft_tpu.redundancy import (
+    DirectoryClient,
+    RedundancyConfig,
+    ShardDirectory,
+    ShardStore,
+    get_shard,
+    get_shard_into,
+    pack_state_blob,
+    plan_placement,
+    reconstruct_state,
+    set_redundancy_fault_hook,
+    unpack_state_blob,
+)
+
+OWN_URL = "http://127.0.0.1:1"  # placement tests never dial holders
+
+
+def _announce_body(
+    owner, epoch, seq, step, k=2, m=1, data_len=12, urls=None
+):
+    return {
+        "replica_id": owner,
+        "epoch": epoch,
+        "seq": seq,
+        "step": step,
+        "k": k,
+        "m": m,
+        "data_len": data_len,
+        "shards": [
+            {
+                "idx": i,
+                "crc": 0,
+                "url": (urls or [OWN_URL] * (k + m))[i],
+                "holder": f"h{i}",
+            }
+            for i in range(k + m)
+        ],
+    }
+
+
+@pytest.fixture()
+def directory():
+    # long dead_after_s: the announce-gap detector must not interfere
+    # with protocol tests that hold generations at different steps
+    d = ShardDirectory(poll_s=0.05, dead_after_s=60.0)
+    yield d
+    d.shutdown()
+
+
+class TestRedundancyConfig:
+    def test_default_env_is_off(self, monkeypatch):
+        for env in (
+            "TORCHFT_REDUNDANCY_K",
+            "TORCHFT_REDUNDANCY_M",
+            "TORCHFT_REDUNDANCY_DIRECTORY",
+        ):
+            monkeypatch.delenv(env, raising=False)
+        cfg = RedundancyConfig.from_env()
+        assert cfg.k == 0
+        assert cfg.enabled is False
+
+    def test_enabled_needs_k_and_directory(self):
+        assert RedundancyConfig(k=2, m=1).enabled is False  # no directory
+        assert RedundancyConfig(k=0, directory="http://d").enabled is False
+        assert RedundancyConfig(k=2, m=1, directory="http://d").enabled
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            {"k": -1},
+            {"k": 2, "m": 0},  # on => at least one parity shard
+            {"k": 200, "m": 56},  # k+m > 255 exceeds GF(256)
+            {"interval": 0},
+            {"timeout_s": 0.0},
+            {"retain": 0},
+        ],
+    )
+    def test_invalid_configs_raise(self, kwargs):
+        with pytest.raises(ValueError):
+            RedundancyConfig(**kwargs).validate()
+
+    def test_bad_env_value_raises(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_REDUNDANCY_K", "two")
+        with pytest.raises(ValueError):
+            RedundancyConfig.from_env()
+
+
+class TestAnnounceStaleness:
+    def test_fresh_announce_accepted(self, directory):
+        code, resp = directory.register("own", "pod0", OWN_URL, False)
+        epoch = resp["epoch"]
+        code, resp = directory.announce(
+            _announce_body("own", epoch, seq=1, step=1)
+        )
+        assert code == 200, resp
+        assert directory.directory()["entries"]["own"]["step"] == 1
+
+    def test_stale_epoch_rejected(self, directory):
+        directory.register("own", "pod0", OWN_URL, False)
+        code, resp = directory.announce(
+            _announce_body("own", "deadbeef0000", seq=1, step=1)
+        )
+        assert code == 409
+        assert resp["error"] == "stale_epoch"
+        assert resp["epoch"] == directory.epoch  # tells the caller the cure
+        assert "own" not in directory.directory()["entries"]
+
+    def test_stale_seq_rejected(self, directory):
+        _, resp = directory.register("own", "pod0", OWN_URL, False)
+        epoch = resp["epoch"]
+        assert directory.announce(
+            _announce_body("own", epoch, seq=5, step=1)
+        )[0] == 200
+        # a replayed or delayed duplicate (same seq) never merges
+        code, resp = directory.announce(
+            _announce_body("own", epoch, seq=5, step=2)
+        )
+        assert (code, resp["error"]) == (409, "stale_seq")
+        code, resp = directory.announce(
+            _announce_body("own", epoch, seq=4, step=2)
+        )
+        assert (code, resp["error"]) == (409, "stale_seq")
+
+    def test_stale_step_rejected(self, directory):
+        _, resp = directory.register("own", "pod0", OWN_URL, False)
+        epoch = resp["epoch"]
+        assert directory.announce(
+            _announce_body("own", epoch, seq=1, step=7)
+        )[0] == 200
+        # fresh seq but non-advancing generation: shard generations are
+        # strictly monotone per owner
+        code, resp = directory.announce(
+            _announce_body("own", epoch, seq=2, step=7)
+        )
+        assert (code, resp["error"]) == (409, "stale_step")
+        assert directory.directory()["entries"]["own"]["seq"] == 1
+
+    def test_replaced_owner_cannot_resurrect(self, directory):
+        _, resp = directory.register("own", "pod0", OWN_URL, False)
+        epoch = resp["epoch"]
+        directory.register("spare", "pod0", "", True)
+        directory.announce(_announce_body("own", epoch, seq=1, step=1))
+        directory.mark_dead("own")
+        assert directory.spare_status("spare")["promote"] is True
+        # the pre-death incarnation wakes up and tries to announce a new
+        # generation into a fleet that already promoted past it
+        code, resp = directory.announce(
+            _announce_body("own", epoch, seq=2, step=2)
+        )
+        assert (code, resp["error"]) == (409, "stale_owner")
+
+    def test_malformed_announce_is_400(self, directory):
+        code, resp = directory.announce({"replica_id": "own"})
+        assert code == 400
+        assert "malformed" in resp["error"]
+
+    def test_http_surface_matches(self, directory):
+        client = DirectoryClient(directory.url, timeout=5.0)
+        epoch = client.register("own", "pod0", OWN_URL)
+        assert client.announce(
+            _announce_body("own", epoch, seq=1, step=1)
+        )[0] == 200
+        code, resp = client.announce(
+            _announce_body("own", "deadbeef0000", seq=2, step=2)
+        )
+        assert (code, resp["error"]) == (409, "stale_epoch")
+        assert client.get_directory()["latest"] == ["own", 1]
+
+    def test_register_revives_dead_replica(self, directory):
+        directory.register("own", "pod0", OWN_URL, False)
+        directory.mark_dead("own")
+        assert "own" in directory.directory()["dead"]
+        directory.register("own", "pod0", OWN_URL, False)
+        assert "own" not in directory.directory()["dead"]
+
+
+class TestSparePromotion:
+    def test_promote_seq_is_monotonic_and_single_use(self, directory):
+        directory.register("own_a", "pod0", OWN_URL, False)
+        directory.register("own_b", "pod0", OWN_URL, False)
+        directory.register("sp1", "pod0", "", True)
+        directory.register("sp2", "pod0", "", True)
+
+        directory.mark_dead("own_a")
+        promos = directory.directory()["promotions"]
+        assert set(promos) == {"sp1"}
+        assert promos["sp1"]["replaces"] == "own_a"
+        first_seq = promos["sp1"]["promote_seq"]
+
+        # a duplicate death notice never double-promotes onto own_a
+        directory.mark_dead("own_a")
+        assert set(directory.directory()["promotions"]) == {"sp1"}
+
+        directory.mark_dead("own_b")
+        promos = directory.directory()["promotions"]
+        assert promos["sp2"]["replaces"] == "own_b"
+        assert promos["sp2"]["promote_seq"] > first_seq
+
+    def test_spare_is_never_unpromoted(self, directory):
+        directory.register("own_a", "pod0", OWN_URL, False)
+        directory.register("sp1", "pod0", "", True)
+        directory.mark_dead("own_a")
+        assert directory.spare_status("sp1")["promote"] is True
+        # a spare restart re-registers; its promotion record must survive
+        directory.register("sp1", "pod0", "", True)
+        status = directory.spare_status("sp1")
+        assert status["promote"] is True
+        assert status["promotion"]["replaces"] == "own_a"
+
+    def test_dead_spare_is_skipped(self, directory):
+        directory.register("own_a", "pod0", OWN_URL, False)
+        directory.register("sp1", "pod0", "", True)
+        directory.register("sp2", "pod0", "", True)
+        directory.mark_dead("sp1")
+        directory.mark_dead("own_a")
+        promos = directory.directory()["promotions"]
+        assert set(promos) == {"sp2"}
+
+    def test_sick_spare_waits_for_clean_health(self, directory):
+        directory.register("own_a", "pod0", OWN_URL, False)
+        directory.register("sp1", "pod0", "", True)
+        # healthwatch.spare_eligible: only a clean OK may join the quorum
+        directory.apply_health(
+            {"replicas": {"sp1": {"state": "warn"}}, "excluded": []}
+        )
+        directory.mark_dead("own_a")
+        assert directory.directory()["promotions"] == {}
+        directory.apply_health(
+            {"replicas": {"sp1": {"state": "ok"}}, "excluded": []}
+        )
+        directory._maybe_promote()  # the background tick's exact call
+        assert directory.spare_status("sp1")["promote"] is True
+
+    def test_excluded_replica_counts_as_dead(self, directory):
+        directory.register("own_a", "pod0", OWN_URL, False)
+        directory.register("sp1", "pod0", "", True)
+        directory.apply_health({"replicas": {}, "excluded": ["own_a"]})
+        assert "own_a" in directory.directory()["dead"]
+        assert directory.spare_status("sp1")["promote"] is True
+
+
+class TestPlacement:
+    @staticmethod
+    def _peer(rid, pod, spare=False, url="http://h"):
+        return {
+            "replica_id": rid, "pod": pod, "spare": spare, "store_url": url
+        }
+
+    def test_data_in_pod_parity_out_of_pod(self):
+        peers = [
+            self._peer("own", "podA"),
+            self._peer("d1", "podA"),
+            self._peer("d2", "podA"),
+            self._peer("p1", "podB"),
+            self._peer("p2", "podC"),
+            self._peer("sp", "podA", spare=True),
+        ]
+        plan = plan_placement(peers, "own", "podA", k=2, m=2)
+        assert [p["replica_id"] for p in plan[:2]] == ["d1", "d2"]
+        assert [p["replica_id"] for p in plan[2:]] == ["p1", "p2"]
+
+    def test_owner_and_spares_never_hold_shards(self):
+        peers = [
+            self._peer("own", "podA"),
+            self._peer("sp", "podA", spare=True),
+            self._peer("d1", "podB"),
+        ]
+        plan = plan_placement(peers, "own", "podA", k=2, m=1)
+        assert {p["replica_id"] for p in plan} == {"d1"}  # wraps, excluded
+
+    def test_no_eligible_holders_is_none(self):
+        peers = [
+            self._peer("own", "podA"),
+            self._peer("sp", "podA", spare=True),
+            self._peer("nourl", "podA", url=""),
+        ]
+        assert plan_placement(peers, "own", "podA", k=2, m=1) is None
+
+
+class TestShardWire:
+    @pytest.fixture()
+    def store(self):
+        s = ShardStore("holder0")
+        yield s
+        s.shutdown()
+
+    def test_roundtrip_and_crc(self, store):
+        body = np.random.RandomState(0).bytes(100_000)
+        store.put("own", 3, 0, body)
+        got = get_shard(
+            store.url, "own", 3, 0, len(body), shard_crc(body), timeout=5.0
+        )
+        assert got == body
+
+    def test_crc_mismatch_raises(self, store):
+        body = b"x" * 1024
+        store.put("own", 3, 0, body)
+        with pytest.raises(IOError, match="crc32"):
+            get_shard(
+                store.url, "own", 3, 0, len(body), shard_crc(body) ^ 1,
+                timeout=5.0,
+            )
+
+    def test_short_body_is_truncation_not_hang(self, store):
+        body = b"y" * 1024
+        store.put("own", 3, 0, body)
+        with pytest.raises(IOError, match="truncated"):
+            get_shard(
+                store.url, "own", 3, 0, 2048, shard_crc(body), timeout=5.0
+            )
+
+    def test_undersized_buffer_rejected(self, store):
+        with pytest.raises(ValueError, match="buffer"):
+            get_shard_into(
+                bytearray(10), store.url, "own", 3, 0, 1024, 0, timeout=5.0
+            )
+
+    def test_torn_pull_resumes_from_offset(self, store):
+        body = np.random.RandomState(1).bytes(200_000)
+        store.put("own", 3, 0, body)
+        fired = []
+
+        def die_once(event, info):
+            if event == "shard_get" and not fired:
+                fired.append(info)
+                return "die"  # serve half the body, then drop the socket
+            return None
+
+        set_redundancy_fault_hook(die_once)
+        try:
+            got = get_shard(
+                store.url, "own", 3, 0, len(body), shard_crc(body),
+                timeout=5.0,
+            )
+        finally:
+            set_redundancy_fault_hook(None)
+        assert fired, "fault hook never armed — test proves nothing"
+        assert got == body  # streaming crc survived the offset resume
+
+
+class TestReconstruct:
+    K, M = 2, 1
+
+    def _stage(self, directory, owner, step, state, stores, seq=1):
+        blob = pack_state_blob(state)
+        shards = encode_shards(blob, self.K, self.M)
+        _, resp = directory.register(owner, "pod0", "", False)
+        entries = []
+        for i, (shard, holder) in enumerate(zip(shards, stores)):
+            holder.put(owner, step, i, shard)
+            entries.append(
+                {
+                    "idx": i,
+                    "crc": shard_crc(shard),
+                    "url": holder.url,
+                    "holder": holder.replica_id,
+                }
+            )
+        code, aresp = directory.announce(
+            {
+                "replica_id": owner,
+                "epoch": resp["epoch"],
+                "seq": seq,
+                "step": step,
+                "k": self.K,
+                "m": self.M,
+                "data_len": len(blob),
+                "shards": entries,
+            }
+        )
+        assert code == 200, aresp
+        return blob
+
+    @pytest.fixture()
+    def stores(self):
+        ss = [ShardStore(f"holder{i}") for i in range(self.K + self.M)]
+        yield ss
+        for s in ss:
+            s.shutdown()
+
+    def test_parallel_reconstruct_is_bitwise(self, directory, stores):
+        state = {"w": np.random.RandomState(2).randn(4096).astype(np.float32)}
+        self._stage(directory, "own", 5, state, stores)
+        step, got, stats = reconstruct_state(
+            directory.url, owner="own", timeout=10.0, max_workers=3
+        )
+        assert step == 5
+        assert stats["shards_ok"] == self.K + self.M
+        np.testing.assert_array_equal(np.asarray(got["w"]), state["w"])
+
+    def test_dead_data_holder_fails_over_to_parity(self, directory, stores):
+        state = {"w": np.random.RandomState(3).randn(4096).astype(np.float32)}
+        self._stage(directory, "own", 5, state, stores)
+        stores[0].shutdown()  # kills a DATA shard holder
+        step, got, stats = reconstruct_state(
+            directory.url, owner="own", timeout=10.0, max_workers=3
+        )
+        assert stats["shards_failed"] == 1
+        assert stats["shards_ok"] == self.K  # decoded from the survivors
+        np.testing.assert_array_equal(np.asarray(got["w"]), state["w"])
+
+    def test_step_targeted_reconstruct_waits_for_announce(
+        self, directory, stores
+    ):
+        old = {"w": np.zeros(64, np.float32)}
+        new = {"w": np.random.RandomState(4).randn(64).astype(np.float32)}
+        self._stage(directory, "own", 5, old, stores, seq=1)
+
+        def late_announce():
+            time.sleep(0.3)
+            self._stage(directory, "own", 6, new, stores, seq=2)
+
+        t = threading.Thread(target=late_announce)
+        t.start()
+        try:
+            # the heal knows its quorum committed step 6; the announce for
+            # it rides an async worker and lands a beat later — the
+            # settle-poll must wait it out instead of serving step 5
+            step, got, _ = reconstruct_state(
+                directory.url, step=6, timeout=10.0, max_workers=3
+            )
+        finally:
+            t.join()
+        assert step == 6
+        np.testing.assert_array_equal(np.asarray(got["w"]), new["w"])
+
+    def test_pack_unpack_roundtrip_is_bitwise(self):
+        state = {
+            "w": np.random.RandomState(5).randn(17, 3).astype(np.float32),
+            "step": np.int64(9),
+        }
+        got = unpack_state_blob(pack_state_blob(state))
+        np.testing.assert_array_equal(np.asarray(got["w"]), state["w"])
+        assert int(np.asarray(got["step"])) == 9
+
+
+class TestManagerKZeroPin:
+    """Redundancy off (the default) must leave the heal path untouched:
+    ``Manager._recv_checkpoint`` never calls the reconstruct branch, so
+    every byte a heal moves goes through the exact pre-redundancy
+    transport code (referenced from manager.py's redundancy wiring)."""
+
+    def test_heal_with_redundancy_off_never_reconstructs(self, monkeypatch):
+        for env in (
+            "TORCHFT_REDUNDANCY_K",
+            "TORCHFT_REDUNDANCY_M",
+            "TORCHFT_REDUNDANCY_DIRECTORY",
+        ):
+            monkeypatch.delenv(env, raising=False)
+        from torchft_tpu.coordination import LighthouseServer
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.process_group import ProcessGroupHost
+
+        calls = []
+        real = Manager._reconstruct_checkpoint
+
+        def spying(self, quorum):
+            calls.append(quorum)
+            return real(self, quorum)
+
+        monkeypatch.setattr(Manager, "_reconstruct_checkpoint", spying)
+
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+            quorum_tick_ms=20, heartbeat_timeout_ms=800,
+        )
+
+        def train(rid, out):
+            rng = np.random.RandomState(rid + 1)
+            params = {"w": rng.randn(4).astype(np.float32)}  # divergent
+
+            def load_state(sd):
+                params["w"] = np.array(sd["w"], dtype=np.float32)
+
+            def save_state():
+                return {"w": params["w"].copy()}
+
+            manager = Manager(
+                pg=ProcessGroupHost(timeout=10.0),
+                load_state_dict=load_state,
+                state_dict=save_state,
+                min_replica_size=1,
+                use_async_quorum=True,
+                replica_id=f"kzero_{rid}",
+                lighthouse_addr=f"127.0.0.1:{lh.port}",
+                timeout=10.0,
+                quorum_timeout=10.0,
+            )
+            assert manager._redundancy_cfg is None
+            assert manager._shard_stager is None
+            try:
+                while manager.current_step() < 3:
+                    manager.start_quorum()
+                    grads = {"w": np.ones(4, np.float32)}
+                    reduced = manager.allreduce(grads).get_future().wait(
+                        timeout=30
+                    )
+                    if manager.should_commit():
+                        params["w"] = params["w"] - 0.1 * reduced["w"]
+                out[rid] = params["w"].copy()
+            finally:
+                manager.shutdown(wait=False)
+
+        out = {}
+        try:
+            threads = [
+                threading.Thread(target=train, args=(rid, out))
+                for rid in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        finally:
+            lh.shutdown()
+        assert set(out) == {0, 1}, "a replica never finished"
+        # divergent inits ended identical => the heal DID run ...
+        np.testing.assert_array_equal(out[0], out[1])
+        # ... and it never entered the reconstruct branch
+        assert calls == []

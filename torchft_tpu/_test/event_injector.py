@@ -83,6 +83,9 @@ class EventInjector:
         # versions whose announcing publisher dies, and the pull delay spec
         self._serve_kill_versions: set = set()
         self._serve_pull_delay: Optional[Tuple[float, int]] = None
+        # redundancy-plane faults (corrupt_shard / kill_shard_source):
+        # (verdict, owner_prefix, shard_idx|None) -> remaining fire count
+        self._shard_faults: Dict[Tuple[str, str, Optional[int]], int] = {}
         self.count = 0
 
     def stall_prepare_at(self, replica: int, step: int) -> "EventInjector":
@@ -310,6 +313,82 @@ class EventInjector:
                 return None
             if armed:
                 return "die"
+        return None
+
+    # ---------------------------------------------------- redundancy plane
+    def corrupt_shard(
+        self, replica: str, shard_idx: int, times: int = 1
+    ) -> "EventInjector":
+        """Flip one byte in shard ``shard_idx`` of owner ``replica``'s
+        generation whenever a shard store SERVES it: the fetched body no
+        longer matches the announced crc32, so the reconstructing peer
+        must detect the mismatch, mark the slot missing, and let parity
+        repair it (the codec-level contract, exercised end to end).
+        ``replica`` matches exactly or by prefix (``"replica_0"`` arms
+        every incarnation ``replica_0:<uuid>``). ``times=-1`` corrupts
+        every serve. Installed via the process-wide redundancy fault
+        hook; call :meth:`clear_redundancy_faults` on teardown."""
+        with self._lock:
+            self._shard_faults[("corrupt", str(replica), int(shard_idx))] = (
+                int(times)
+            )
+        self._install_redundancy_hook()
+        return self
+
+    def kill_shard_source(
+        self,
+        replica: str,
+        shard_idx: Optional[int] = None,
+        times: int = -1,
+    ) -> "EventInjector":
+        """Drop the connection whenever a store serves owner ``replica``'s
+        shard ``shard_idx`` (``None`` = any shard of that owner) — the
+        shape of a shard holder dying mid-pull. The reconstructing peer's
+        ranged resume budget exhausts against the dead slot and per-shard
+        failover marks it missing; decode proceeds from the surviving
+        ``k``. ``times=-1`` (default) kills every serve."""
+        key = (
+            "die",
+            str(replica),
+            None if shard_idx is None else int(shard_idx),
+        )
+        with self._lock:
+            self._shard_faults[key] = int(times)
+        self._install_redundancy_hook()
+        return self
+
+    def clear_redundancy_faults(self) -> None:
+        from torchft_tpu import redundancy
+
+        with self._lock:
+            self._shard_faults.clear()
+        redundancy.set_redundancy_fault_hook(None)
+
+    def _install_redundancy_hook(self) -> None:
+        from torchft_tpu import redundancy
+
+        redundancy.set_redundancy_fault_hook(self._redundancy_fault_hook)
+
+    def _redundancy_fault_hook(
+        self, event: str, info: Dict[str, object]
+    ) -> Optional[str]:
+        if event != "shard_get":
+            return None
+        owner = str(info.get("owner", ""))
+        idx = int(info.get("idx", -1))  # type: ignore[arg-type]
+        with self._lock:
+            for key, remaining in self._shard_faults.items():
+                verdict, armed_owner, armed_idx = key
+                if remaining == 0:
+                    continue
+                if not (owner == armed_owner or owner.startswith(armed_owner)):
+                    continue
+                if armed_idx is not None and armed_idx != idx:
+                    continue
+                if remaining > 0:
+                    self._shard_faults[key] = remaining - 1
+                self.count += 1
+                return verdict
         return None
 
     # ------------------------------------------------- control-plane flakes

@@ -1,5 +1,11 @@
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.checkpointing.durable import DurableCheckpointer
+from torchft_tpu.checkpointing.erasure import (
+    decode_shards,
+    encode_shards,
+    shard_crc,
+    shard_length,
+)
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
 from torchft_tpu.checkpointing.pg_transport import PGTransport
 from torchft_tpu.checkpointing.transport import CheckpointTransport
@@ -10,4 +16,8 @@ __all__ = [
     "DurableCheckpointer",
     "HTTPTransport",
     "PGTransport",
+    "decode_shards",
+    "encode_shards",
+    "shard_crc",
+    "shard_length",
 ]

@@ -1,0 +1,244 @@
+"""Systematic erasure codec for the redundancy plane (pure numpy).
+
+``k`` data shards + ``m`` parity shards over GF(256): any ``k`` of the
+``k + m`` shards reconstruct the original payload bitwise. The code is
+**systematic** — the first ``k`` shards are verbatim slices of the
+payload — so the common reconstruct case (all data-shard holders alive)
+is a concatenation with zero field arithmetic, and parity math only runs
+for the shards that are actually missing or corrupt.
+
+Construction is the classic Vandermonde-then-normalize generator (the
+same scheme as Backblaze's JavaReedSolomon and torchsnapshot-style RS
+codecs): build a ``(k+m) x k`` Vandermonde matrix over distinct field
+points, right-multiply by the inverse of its top ``k x k`` block so the
+data rows become the identity. Any ``k`` rows of a Vandermonde matrix
+with distinct points are themselves a square Vandermonde matrix —
+invertible — and right-multiplication by a fixed invertible matrix
+preserves that, so **every** ``k``-subset of shards decodes.
+
+``m == 1`` degenerates to XOR parity (the normalized single parity row
+is all-ones), which the hot paths exploit implicitly: one missing shard
+costs ``k`` table-gathered multiplies either way, and for ``m == 1``
+those coefficients are 1 so the gather is the identity lookup.
+
+Payloads are padded to ``k * shard_len``; the true length travels in the
+shard directory entry (``data_len``) and is restored on decode. All
+arithmetic is vectorized through a lazily-built 256x256 GF(256) product
+table (64 KiB), so per-shard work is numpy fancy-indexing gathers + XOR
+reductions — no Python-level byte loops.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "encode_shards",
+    "decode_shards",
+    "encoding_matrix",
+    "shard_crc",
+    "shard_length",
+]
+
+_GF_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1, the AES-adjacent standard
+
+
+def _build_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= _GF_POLY
+    exp[255:510] = exp[0:255]  # wraparound so exp[a+b] never needs a mod
+    # full product table: MUL[a, b] = a * b in GF(256). 64 KiB, built once.
+    a = np.arange(256, dtype=np.int32)
+    la, lb = np.meshgrid(log[a], log[a], indexing="ij")
+    mul = exp[(la + lb) % 255].astype(np.uint8)
+    mul[0, :] = 0
+    mul[:, 0] = 0
+    return exp, log, mul
+
+
+_EXP: Optional[np.ndarray] = None
+_LOG: Optional[np.ndarray] = None
+_MUL: Optional[np.ndarray] = None
+
+
+def _tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    global _EXP, _LOG, _MUL
+    if _MUL is None:
+        _EXP, _LOG, _MUL = _build_tables()
+    return _EXP, _LOG, _MUL  # type: ignore[return-value]
+
+
+def _gf_mul_scalar(a: int, b: int) -> int:
+    _, _, mul = _tables()
+    return int(mul[a, b])
+
+
+def _gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("GF(256) inverse of 0")
+    exp, log, _ = _tables()
+    return int(exp[255 - int(log[a])])
+
+
+def _gf_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Matrix product over GF(256) for small coefficient matrices."""
+    _, _, mul = _tables()
+    out = np.zeros((a.shape[0], b.shape[1]), dtype=np.uint8)
+    for i in range(a.shape[0]):
+        acc = np.zeros(b.shape[1], dtype=np.uint8)
+        for t in range(a.shape[1]):
+            acc ^= mul[a[i, t]][b[t]]
+        out[i] = acc
+    return out
+
+
+def _gf_matinv(mat: np.ndarray) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(256) (coefficient-sized: k <= 255)."""
+    _, _, mul = _tables()
+    n = mat.shape[0]
+    aug = np.concatenate(
+        [mat.astype(np.uint8).copy(), np.eye(n, dtype=np.uint8)], axis=1
+    )
+    for col in range(n):
+        pivot = next(
+            (r for r in range(col, n) if aug[r, col] != 0), None
+        )
+        if pivot is None:
+            raise ValueError("singular matrix over GF(256)")
+        if pivot != col:
+            aug[[col, pivot]] = aug[[pivot, col]]
+        inv_p = _gf_inv(int(aug[col, col]))
+        aug[col] = mul[inv_p][aug[col]]
+        for r in range(n):
+            if r != col and aug[r, col] != 0:
+                aug[r] ^= mul[int(aug[r, col])][aug[col]]
+    return aug[:, n:]
+
+
+def encoding_matrix(k: int, m: int) -> np.ndarray:
+    """The systematic ``(k+m) x k`` generator: identity on top, parity
+    coefficient rows below. Deterministic in (k, m) — encoder and every
+    decoder derive the same matrix independently."""
+    if k < 1 or m < 0 or k + m > 255:
+        raise ValueError(f"unsupported erasure geometry k={k} m={m}")
+    _tables()
+    if m == 1:
+        # RAID-5 degenerate case: identity + all-ones XOR row. Any k rows
+        # are either the identity or k-1 unit rows + the ones row — both
+        # invertible — and encode/repair needs no field multiplies.
+        return np.concatenate(
+            [np.eye(k, dtype=np.uint8), np.ones((1, k), dtype=np.uint8)]
+        )
+    # Vandermonde over the distinct points 0..k+m-1: row r = [r^0 .. r^(k-1)]
+    # (0^0 == 1 by convention, so row 0 is [1, 0, 0, ...])
+    vand = np.zeros((k + m, k), dtype=np.uint8)
+    for r in range(k + m):
+        acc = 1
+        for c in range(k):
+            vand[r, c] = acc
+            acc = _gf_mul_scalar(acc, r)
+    top_inv = _gf_matinv(vand[:k])
+    gen = _gf_matmul(vand, top_inv)
+    # normalization guarantee: the data block is exactly the identity
+    gen[:k] = np.eye(k, dtype=np.uint8)
+    return gen
+
+
+def shard_length(data_len: int, k: int) -> int:
+    """Per-shard byte length for a payload of ``data_len`` (ceil-div,
+    min 1 so zero-length payloads still produce addressable shards)."""
+    return max(1, (int(data_len) + k - 1) // k)
+
+
+def encode_shards(payload, k: int, m: int) -> List[bytes]:
+    """Encode ``payload`` (bytes-like) into ``k + m`` shards.
+
+    Shards ``0..k-1`` are verbatim payload slices (zero-padded tail);
+    shards ``k..k+m-1`` are GF(256) parity. Bitwise round-trip with
+    :func:`decode_shards` is pinned by tests/test_erasure.py.
+    """
+    _, _, mul = _tables()
+    data = np.frombuffer(memoryview(payload), dtype=np.uint8)
+    slen = shard_length(data.nbytes, k)
+    padded = np.zeros(k * slen, dtype=np.uint8)
+    padded[: data.nbytes] = data
+    rows = padded.reshape(k, slen)
+    gen = encoding_matrix(k, m)
+    shards: List[bytes] = [rows[i].tobytes() for i in range(k)]
+    for p in range(m):
+        coefs = gen[k + p]
+        acc = np.zeros(slen, dtype=np.uint8)
+        for i in range(k):
+            c = int(coefs[i])
+            if c == 0:
+                continue
+            acc ^= rows[i] if c == 1 else mul[c][rows[i]]
+        shards.append(acc.tobytes())
+    return shards
+
+
+def decode_shards(
+    shards: Sequence[Optional[bytes]], k: int, m: int, data_len: int
+) -> bytes:
+    """Reconstruct the original payload from any ``k`` present shards.
+
+    ``shards`` is the full ``k + m`` slot list with ``None`` for
+    missing/corrupt entries (callers drop a shard by CRC mismatch before
+    decoding). Raises ``ValueError`` when fewer than ``k`` survive.
+    """
+    _, _, mul = _tables()
+    if len(shards) != k + m:
+        raise ValueError(f"expected {k + m} shard slots, got {len(shards)}")
+    present = [i for i, s in enumerate(shards) if s is not None]
+    if len(present) < k:
+        raise ValueError(
+            f"unrecoverable: only {len(present)} of {k + m} shards present "
+            f"(need {k})"
+        )
+    slen = shard_length(data_len, k)
+    use = present[:k]
+    if use == list(range(k)):
+        # systematic fast path: all data shards arrived — pure concat
+        out = np.concatenate(
+            [np.frombuffer(shards[i], dtype=np.uint8) for i in range(k)]
+        )
+        return out[:data_len].tobytes()
+    gen = encoding_matrix(k, m)
+    sub = gen[use]  # k x k, invertible by the Vandermonde property
+    dec = _gf_matinv(sub)
+    rows = [
+        np.frombuffer(shards[i], dtype=np.uint8) for i in use
+    ]
+    for r in rows:
+        if r.nbytes != slen:
+            raise ValueError(
+                f"shard length mismatch: got {r.nbytes}, expected {slen}"
+            )
+    out = np.empty(k * slen, dtype=np.uint8)
+    for d in range(k):
+        coefs = dec[d]
+        acc = np.zeros(slen, dtype=np.uint8)
+        for t in range(k):
+            c = int(coefs[t])
+            if c == 0:
+                continue
+            acc ^= rows[t] if c == 1 else mul[c][rows[t]]
+        out[d * slen : (d + 1) * slen] = acc
+    return out[:data_len].tobytes()
+
+
+def shard_crc(shard) -> int:
+    """crc32 over a shard body — the same checksum family the ranged
+    HTTP transport trailers use, so corrupt shards are detected before
+    they reach the decoder."""
+    return zlib.crc32(memoryview(shard)) & 0xFFFFFFFF

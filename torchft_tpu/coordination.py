@@ -330,6 +330,7 @@ class LighthouseServer:
         metrics_per_replica_limit: "Optional[int]" = None,
         serve_registry: bool = False,
         serve_drain_on: "Optional[str]" = None,
+        redundancy_directory: bool = False,
     ) -> None:
         """``health`` configures the healthwatch ledger (HealthOpts fields,
         see torchft_tpu/healthwatch.py); None reads ``TORCHFT_HEALTH_*``
@@ -343,7 +344,11 @@ class LighthouseServer:
         ``serve_registry=True`` co-hosts a serving-plane SnapshotRegistry
         that polls this lighthouse's /health summary to drain unhealthy
         sources (``serve_drain_on``: "warn"/"eject", None reads
-        ``TORCHFT_SERVE_DRAIN_ON``); see docs/serving.md."""
+        ``TORCHFT_SERVE_DRAIN_ON``); see docs/serving.md.
+        ``redundancy_directory=True`` co-hosts a redundancy-plane
+        ShardDirectory that tracks erasure-coded shard placements, polls
+        this lighthouse's /health ledger for owner deaths, and promotes
+        hot spares into the next quorum (docs/operations.md)."""
         lib = _load()
         if health is None:
             from torchft_tpu.healthwatch import HealthConfig
@@ -385,6 +390,16 @@ class LighthouseServer:
             self.serve_registry = SnapshotRegistry(
                 lighthouse_addr=self.address(), drain_on=drain_on
             )
+        self.redundancy_directory = None
+        if redundancy_directory:
+            # lazy import, same reason as the serving registry above:
+            # redundancy.py imports LighthouseClient back from here for
+            # the directory's health poll
+            from torchft_tpu.redundancy import ShardDirectory
+
+            self.redundancy_directory = ShardDirectory(
+                lighthouse_addr=self.address()
+            )
 
     def address(self) -> str:
         return _take_str(self._lib, self._lib.tft_lighthouse_address(self._handle))
@@ -396,10 +411,20 @@ class LighthouseServer:
     def serve_registry_url(self) -> "Optional[str]":
         return self.serve_registry.url if self.serve_registry is not None else None
 
+    def redundancy_directory_url(self) -> "Optional[str]":
+        return (
+            self.redundancy_directory.url
+            if self.redundancy_directory is not None
+            else None
+        )
+
     def shutdown(self) -> None:
         if self.serve_registry is not None:
             self.serve_registry.shutdown()
             self.serve_registry = None
+        if self.redundancy_directory is not None:
+            self.redundancy_directory.shutdown()
+            self.redundancy_directory = None
         if self._handle:
             self._lib.tft_lighthouse_shutdown(self._handle)
 

@@ -267,6 +267,28 @@ def serving_eligible(
     return state not in SERVE_DRAIN_STATES[drain_on]
 
 
+def spare_eligible(state: "HealthState | int | str") -> bool:
+    """True when a hot spare in ``state`` may be PROMOTED into the quorum
+    (redundancy plane, docs/operations.md).
+
+    Promotion is the strictest gate in the repo: swapping a sick spare
+    into a quorum trades one dead member for one straggling member, so
+    only a clean OK qualifies — WARN/EJECTED/PROBATION spares stay
+    shadowing until the ledger clears them. A spare the ledger has never
+    seen (it doesn't train, so it may have no samples) reports "ok" and
+    qualifies; genuinely unknown state strings do not."""
+    if isinstance(state, str):
+        parsed = _STATE_NAMES.get(state.strip().lower())
+        if parsed is None:
+            return False
+        state = parsed
+    try:
+        state = HealthState(int(state))
+    except (ValueError, TypeError):
+        return False
+    return state == HealthState.OK
+
+
 @dataclass
 class _Replica:
     window: List[float] = field(default_factory=list)

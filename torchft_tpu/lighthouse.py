@@ -61,6 +61,15 @@ def main(argv: "list[str] | None" = None) -> None:
         help="health state at which the registry drains a serving source "
         "(default: $TORCHFT_SERVE_DRAIN_ON or warn)",
     )
+    parser.add_argument(
+        "--redundancy-directory", "--redundancy_directory",
+        action="store_true",
+        help="co-host a redundancy-plane shard directory: tracks "
+        "erasure-coded shard placements, detects owner deaths off this "
+        "lighthouse's /health ledger, and promotes hot spares "
+        "(docs/operations.md); point replicas at it via "
+        "TORCHFT_REDUNDANCY_DIRECTORY",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -73,6 +82,7 @@ def main(argv: "list[str] | None" = None) -> None:
         history_path=args.history,
         serve_registry=args.serve_registry,
         serve_drain_on=args.serve_drain_on,
+        redundancy_directory=args.redundancy_directory,
     )
     logging.info("lighthouse listening at %s", server.address())
     if server.serve_registry is not None:
@@ -80,6 +90,12 @@ def main(argv: "list[str] | None" = None) -> None:
             "snapshot registry serving at %s (epoch %s)",
             server.serve_registry.url,
             server.serve_registry.epoch,
+        )
+    if server.redundancy_directory is not None:
+        logging.info(
+            "shard directory serving at %s (epoch %s)",
+            server.redundancy_directory.url,
+            server.redundancy_directory.epoch,
         )
 
     stop = threading.Event()

@@ -120,6 +120,21 @@ _COUNTER_TIMINGS = frozenset(
         "readmissions",
         "dropped_events",
         "trace_dropped",
+        # standby snapshot refused because this replica is itself mid-heal
+        # (see _async_quorum_body): a fallback peer asking us for state
+        # would get the stale pre-heal copy, so we decline loudly
+        "standby_skipped",
+        # redundancy plane (redundancy.py): shard staging + reconstruct
+        "shards_staged",
+        "shard_stage_skipped",
+        "shard_stage_dropped",
+        "shard_stage_failed",
+        "shard_put_failed",
+        "shard_announce_rejected",
+        "reconstructs",
+        "reconstruct_failures",
+        "shard_corrupt",
+        "shard_fetch_failed",
     }
 )
 
@@ -217,6 +232,7 @@ class Manager:
         compress: Optional[str] = None,
         tracing: Optional[bool] = None,
         metrics_port: Optional[int] = None,
+        spare: bool = False,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -288,7 +304,38 @@ class Manager:
             )
         self._checkpoint_transport: CheckpointTransport = checkpoint_transport
 
-        if group_rank == 0:
+        # Hot-spare role (redundancy.py, docs/operations.md): a spare
+        # shadows the fleet WITHOUT joining the quorum — no ManagerServer,
+        # no lighthouse heartbeat — so the quorum never counts or waits on
+        # it. The control-plane join is deferred into promote(), which
+        # fires when the shard directory promotes this spare to replace a
+        # dead member; until then the quorum-facing methods (start_quorum,
+        # should_commit, allreduce) must not be called.
+        self._spare = spare
+        self._spare_join_args: Optional[Dict[str, Any]] = None
+        self._spare_promotion: Optional[Dict[str, Any]] = None
+        manager_addr: Optional[str] = None
+        if spare:
+            if group_rank != 0:
+                raise ValueError(
+                    "Manager(spare=True) is a whole-replica role: only "
+                    "group_rank 0 may construct it"
+                )
+            replica_name = replica_id if replica_id is not None else "spare"
+            self._replica_id = f"{replica_name}:{uuid.uuid4()}"
+            self._spare_join_args = {
+                "hostname": hostname,
+                "store_addr": store_addr,
+                "lighthouse_addr": (
+                    lighthouse_addr
+                    if lighthouse_addr is not None
+                    else os.environ.get(LIGHTHOUSE_ENV)
+                ),
+                "group_world_size": group_world_size,
+                "heartbeat_interval": heartbeat_interval,
+                "quorum_retries": quorum_retries,
+            }
+        elif group_rank == 0:
             # Group leader: owns the rendezvous store and the manager server.
             if store_addr is None:
                 bind_port = int(os.environ.get(MANAGER_PORT_ENV, 0))
@@ -330,16 +377,21 @@ class Manager:
             self._replica_id = replica_id if replica_id is not None else "replica"
 
         self._store_addr = store_addr
-        self._client = ManagerClient(manager_addr, connect_timeout=self._connect_timeout)
-        # Dedicated client for the per-step commit vote: the native RPC
-        # client keeps ONE cached keep-alive connection per handle, and a
-        # call that arrives while another thread holds it falls back to a
-        # one-shot connect. The quorum thread's RPC is in flight exactly
-        # when the main thread votes (async quorum), so sharing a handle
-        # would put a TCP connect on the hot path every overlapped step.
-        self._vote_client = ManagerClient(
-            manager_addr, connect_timeout=self._connect_timeout
-        )
+        self._client: Optional[ManagerClient] = None
+        self._vote_client: Optional[ManagerClient] = None
+        if manager_addr is not None:
+            self._client = ManagerClient(
+                manager_addr, connect_timeout=self._connect_timeout
+            )
+            # Dedicated client for the per-step commit vote: the native RPC
+            # client keeps ONE cached keep-alive connection per handle, and a
+            # call that arrives while another thread holds it falls back to a
+            # one-shot connect. The quorum thread's RPC is in flight exactly
+            # when the main thread votes (async quorum), so sharing a handle
+            # would put a TCP connect on the hot path every overlapped step.
+            self._vote_client = ManagerClient(
+                manager_addr, connect_timeout=self._connect_timeout
+            )
 
         # bucketed managed allreduce: cap resolution order is env var >
         # constructor > default; 0 disables (per-leaf collectives)
@@ -429,13 +481,17 @@ class Manager:
             "rpc_retries",
             "chunk_crc_failures",
             "collective_reroute",
+            "standby_skipped",
         ):
             self._timings[_counter] = 0.0
         # rpc_retries: every retried control-plane call on either manager
         # client bumps the counter and leaves a flight-recorder breadcrumb,
         # so "the step got slower" is attributable to a named RPC.
-        self._client.set_retry_observer(self._on_rpc_retry)
-        self._vote_client.set_retry_observer(self._on_rpc_retry)
+        # (A spare has no clients until promote() joins the control plane.)
+        if self._client is not None:
+            self._client.set_retry_observer(self._on_rpc_retry)
+        if self._vote_client is not None:
+            self._vote_client.set_retry_observer(self._on_rpc_retry)
         # collective_reroute: the compressed ring re-formed around a dead
         # link mid-collective. Same pattern as rpc_retries — counter plus a
         # flight-recorder breadcrumb naming the link.
@@ -538,6 +594,57 @@ class Manager:
                     f"({e}); continuing without /metrics"
                 )
 
+        # redundancy plane (redundancy.py, docs/operations.md): when
+        # TORCHFT_REDUNDANCY_K >= 1 and a shard directory is configured,
+        # the group leader erasure-codes every committed generation and
+        # stages the shards across peers off the hot path, and the heal
+        # path tries a parallel reconstruct before the serial peer pull.
+        # k=0 (the default) leaves every existing path byte-identical —
+        # pinned by tests/test_redundancy.py.
+        self._redundancy_cfg: Optional[Any] = None
+        self._shard_stager: Optional[Any] = None
+        self._hot_spare: Optional[Any] = None
+        self._redundancy_stage_pending = False
+        try:
+            from torchft_tpu import redundancy as _redundancy
+
+            _red_cfg = _redundancy.RedundancyConfig.from_env()
+            if spare:
+                if not _red_cfg.directory:
+                    raise ValueError(
+                        "Manager(spare=True) requires a shard directory "
+                        f"(${_redundancy.REDUNDANCY_DIRECTORY_ENV})"
+                    )
+                self._redundancy_cfg = _red_cfg
+                self._hot_spare = _redundancy.HotSpare(
+                    _red_cfg,
+                    spare_id=self._replica_id,
+                    # shadow the serving-plane delta chain too when the
+                    # registry is configured (serving.SERVE_REGISTRY_ENV)
+                    serve_registry=os.environ.get(
+                        "TORCHFT_SERVE_REGISTRY", ""
+                    )
+                    or None,
+                    on_metric=self._on_redundancy_metric,
+                )
+            elif _red_cfg.enabled:
+                _red_cfg.validate()
+                self._redundancy_cfg = _red_cfg
+                if group_rank == 0:
+                    self._shard_stager = _redundancy.ShardStager(
+                        _red_cfg,
+                        self._replica_id,
+                        on_metric=self._on_redundancy_metric,
+                    )
+        except ValueError:
+            raise
+        except Exception:  # noqa: BLE001 — the plane is advisory
+            self._logger.exception(
+                "redundancy plane failed to attach; continuing without it"
+            )
+            self._redundancy_cfg = None
+            self._shard_stager = None
+
     # ------------------------------------------------------------- state fns
     def register_state_dict_fn(
         self,
@@ -604,6 +711,16 @@ class Manager:
                 "pg no longer requires sync quorum; restoring async quorum"
             )
             self._use_async_quorum = True
+
+        if self._shard_stager is not None and self._redundancy_stage_pending:
+            # redundancy plane: the previous round committed and the
+            # caller has applied its update — the user state is now the
+            # exact post-commit generation a healer joining THIS round
+            # needs, and announcing before the quorum/allreduce barrier
+            # means that healer can reconstruct it instead of deadlocking
+            # on a commit it is itself blocking
+            self._redundancy_stage_pending = False
+            self._stage_redundancy_committed()
 
         self._errored = None
         self._healing = False
@@ -846,12 +963,25 @@ class Manager:
                 # disallow_checkpoint while _standby_source is set).
                 # Pull-based transports only: a PGTransport standby would
                 # just rendezvous a transfer no one initiates.
-                standby = (
-                    not quorum.heal
-                    and not quorum.recover_dst_replica_ranks
+                standby_wanted = (
+                    not quorum.recover_dst_replica_ranks
                     and quorum.max_world_size < quorum.replica_world_size
                     and self._checkpoint_transport.supports_multi_source
                 )
+                standby = standby_wanted and not quorum.heal
+                if standby_wanted and quorum.heal:
+                    # We are a fallback candidate AND behind ourselves: the
+                    # quorum listed us as a standby source, but our local
+                    # state is the pre-heal copy — serving it would hand a
+                    # failing-over peer stale state. Refuse loudly instead
+                    # of silently staging nothing (the old behavior left
+                    # fallback peers shardless with no audit trail).
+                    self._logger.warning(
+                        "refusing to stage standby failover snapshot for "
+                        f"step {quorum.max_step}: this replica is itself "
+                        "mid-heal and holds stale state"
+                    )
+                    self._bump_counter("standby_skipped")
                 if standby and not self._standby_source:
                     self._logger.info(
                         "staging standby failover snapshot for "
@@ -952,6 +1082,77 @@ class Manager:
             **fields,
         )
 
+    def _on_redundancy_metric(self, name: str, value: float) -> None:
+        """ShardStager/HotSpare → Manager metrics bridge: counters (named
+        in _COUNTER_TIMINGS) accumulate, everything else is a last-value
+        gauge riding timings() like any phase timing."""
+        if name in _COUNTER_TIMINGS:
+            self._bump_counter(name, value)
+        else:
+            self._record_timing(name, value)
+
+    def _on_redundancy_event(self, kind: str, info: Dict[str, Any]) -> None:
+        """reconstruct_state → Manager bridge: per-shard faults become
+        cumulative counters + tracer instants so a heal postmortem can say
+        WHICH shard failed or arrived corrupt."""
+        counter = {
+            "shard_corrupt": "shard_corrupt",
+            "shard_fetch_failed": "shard_fetch_failed",
+        }.get(kind)
+        if counter is not None:
+            self._bump_counter(counter)
+        self._tracer.instant(kind, cat="redundancy", **info)
+
+    def _reconstruct_checkpoint(self, quorum: Any) -> Optional[Dict[str, Any]]:
+        """Attempt the parallel shard reconstruct for this heal. Returns
+        the state dict on success, None to fall back to the peer pull
+        (never raises — the redundancy plane is an accelerator, not a
+        dependency, of healing)."""
+        from torchft_tpu import redundancy as _redundancy
+
+        cfg = self._redundancy_cfg
+        assert cfg is not None
+        t0 = time.perf_counter()
+        try:
+            with self._tracer.span(
+                "reconstruct", cat="redundancy", step=quorum.max_step
+            ):
+                step, state, stats = _redundancy.reconstruct_state(
+                    cfg.directory,
+                    step=quorum.max_step,
+                    timeout=self._timeout,
+                    on_event=self._on_redundancy_event,
+                )
+        except Exception as e:  # noqa: BLE001 — fall back to peer pull
+            self._logger.warning(
+                f"shard reconstruct unavailable ({e!r}); falling back to "
+                "peer heal"
+            )
+            self._bump_counter("reconstruct_failures")
+            return None
+        if step != quorum.max_step:
+            self._logger.warning(
+                f"shard directory generation is step {step}, quorum wants "
+                f"{quorum.max_step}; falling back to peer heal"
+            )
+            self._bump_counter("reconstruct_failures")
+            return None
+        self._bump_counter("reconstructs")
+        self._record_timing(
+            "reconstruct_s", stats.get("reconstruct_s", time.perf_counter() - t0)
+        )
+        self._record_timing(
+            "reconstruct_mb_per_s", float(stats.get("mb_per_s", 0.0))
+        )
+        self._logger.info(
+            f"healed step {step} by parallel reconstruct: "
+            f"{stats['shards_ok']} shards ok, "
+            f"{stats['shards_failed']} failed, "
+            f"{stats['shards_corrupt']} corrupt, "
+            f"{stats.get('mb_per_s', 0.0):.1f} MB/s"
+        )
+        return state
+
     def _recv_checkpoint(self, quorum: Any) -> Dict[str, Any]:
         """Fetch the healing checkpoint, failing over across up-to-date
         peers when the transport supports it (pull-based HTTP). Push-based
@@ -959,6 +1160,17 @@ class Manager:
         fallback peer there would never send, so failing over to it could
         only hang (see ``CheckpointTransport.supports_multi_source``)."""
         transport = self._checkpoint_transport
+        # Reconstruct mode (redundancy.py): with the plane enabled, try to
+        # rebuild the generation from erasure shards pulled in PARALLEL
+        # from distinct peers before falling back to the serial pull. Any
+        # failure — directory empty, stale generation, fewer than k shards
+        # surviving — degrades to the existing heal path, so k=0 and a
+        # broken plane behave identically (byte-identical path pinned by
+        # tests/test_redundancy.py).
+        if self._redundancy_cfg is not None and self._redundancy_cfg.enabled:
+            state = self._reconstruct_checkpoint(quorum)
+            if state is not None:
+                return state
         if transport.supports_multi_source:
             sources = self._heal_sources(quorum)
             self._logger.info(
@@ -2190,6 +2402,126 @@ class Manager:
             self._logger.exception("serve snapshot publish failed")
         self._record_timing("serve_publish_s", time.perf_counter() - t0)
 
+    def _stage_redundancy_committed(self) -> None:
+        """Round-start hook for the redundancy plane: hand the committed
+        composite state (the update the caller just applied, labeled with
+        the step about to run — exactly what a healer joining this round
+        must load) to the ShardStager. The hot path pays one host
+        snapshot copy + a queue put; encode/PUT/announce are the worker's.
+        Never raises — staging is advisory."""
+        t0 = time.perf_counter()
+        try:
+            with self._tracer.span(
+                "shard_stage", cat="redundancy", step=self._step
+            ):
+                self._shard_stager.stage(self._step, self._manager_state_dict())
+        except Exception:  # noqa: BLE001 — advisory plane
+            self._bump_counter("shard_stage_failed")
+            self._logger.exception("redundancy shard staging failed")
+        self._record_timing("shard_stage_hot_s", time.perf_counter() - t0)
+
+    # ---------------------------------------------------------- hot spare
+    def promote(
+        self, timeout: "float | timedelta | None" = None
+    ) -> Dict[str, Any]:
+        """Hot-spare promotion: block until the shard directory promotes
+        this spare into the fleet (a member died), load the freshest
+        prefetched state, and ONLY THEN join the control plane — create
+        the rendezvous store, the ManagerServer (which heartbeats the
+        lighthouse and so enters the next quorum), and the RPC clients.
+        Returns the directory's promotion record. After this returns the
+        Manager behaves exactly like one constructed with spare=False: the
+        next start_quorum()/should_commit() cycle converges it bitwise
+        (the prefetched generation IS a committed generation, so at worst
+        one incremental heal covers the steps staged since)."""
+        if not self._spare or self._hot_spare is None:
+            raise RuntimeError("promote() requires Manager(spare=True)")
+        budget = _to_seconds(timeout) if timeout is not None else None
+        result = self._hot_spare.wait_promoted(timeout=budget)
+        if result is None:
+            raise TimeoutError(
+                f"spare {self._replica_id} not promoted within {budget}s"
+            )
+        state_step, state, promotion = result
+        self._spare_promotion = promotion
+        if state is not None:
+            with self._state_dict_lock.w_lock():
+                user = state.get("user", {})
+                for key, load_fn in self._load_state_dict_fns.items():
+                    if key in user:
+                        load_fn(user[key])
+            self.load_state_dict(state["torchft"])
+            self._logger.info(
+                f"spare promoted at prefetched step {state_step} "
+                f"(replacing {promotion.get('replaces')!r})"
+            )
+        else:
+            self._logger.warning(
+                "spare promoted with no prefetched generation — joining "
+                "cold; the first quorum will heal it like any rejoiner"
+            )
+        self._hot_spare.shutdown()
+        self._join_control_plane()
+        # a promoted spare is a full member: it starts staging its own
+        # shard generations like any group leader with the plane enabled
+        if self._redundancy_cfg is not None and self._redundancy_cfg.enabled:
+            try:
+                from torchft_tpu import redundancy as _redundancy
+
+                self._shard_stager = _redundancy.ShardStager(
+                    self._redundancy_cfg,
+                    self._replica_id,
+                    on_metric=self._on_redundancy_metric,
+                )
+            except Exception:  # noqa: BLE001 — advisory plane
+                self._logger.exception(
+                    "promoted spare could not start its shard stager"
+                )
+        self._record_timing("spare_promote_step", float(state_step))
+        return promotion
+
+    def _join_control_plane(self) -> None:
+        """The deferred half of __init__ for a spare: identical wiring to
+        the group-leader branch, run at promotion time so the lighthouse
+        only ever sees the spare once it is a real member."""
+        args = self._spare_join_args
+        assert args is not None, "control plane already joined"
+        self._spare_join_args = None
+        hostname = args["hostname"]
+        store_addr = args["store_addr"]
+        if store_addr is None:
+            self._store = KvStoreServer("0.0.0.0:0")
+            store_addr = f"{hostname}:{self._store.port}"
+        lighthouse_addr = args["lighthouse_addr"]
+        if lighthouse_addr is None:
+            lighthouse_addr = os.environ[LIGHTHOUSE_ENV]
+        bind_port = int(os.environ.get(MANAGER_PORT_ENV, 0))
+        self._manager = ManagerServer(
+            replica_id=self._replica_id,
+            lighthouse_addr=lighthouse_addr,
+            hostname=hostname,
+            bind=f"0.0.0.0:{bind_port}",
+            store_addr=store_addr,
+            world_size=args["group_world_size"],
+            heartbeat_interval=args["heartbeat_interval"],
+            connect_timeout=self._connect_timeout,
+            quorum_retries=args["quorum_retries"],
+            aggregator_addr=os.environ.get(AGGREGATOR_ENV, ""),
+        )
+        manager_addr = self._manager.address()
+        KvClient(store_addr, connect_timeout=self._connect_timeout).set(
+            "manager_addr", manager_addr, timeout=self._timeout
+        )
+        self._store_addr = store_addr
+        self._client = ManagerClient(
+            manager_addr, connect_timeout=self._connect_timeout
+        )
+        self._vote_client = ManagerClient(
+            manager_addr, connect_timeout=self._connect_timeout
+        )
+        self._client.set_retry_observer(self._on_rpc_retry)
+        self._vote_client.set_retry_observer(self._on_rpc_retry)
+
     # -------------------------------------------------------- healthwatch
     def set_telemetry_transform(
         self, fn: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]]
@@ -2523,6 +2855,16 @@ class Manager:
                 # publish the committed snapshot BEFORE the step advances:
                 # the serving version is stamped with the step that voted
                 self._serve_publish_committed()
+            if self._shard_stager is not None:
+                # redundancy plane: arm staging for the NEXT round start.
+                # Staging here would label the generation with the step
+                # that just voted, but a healer joining round M needs the
+                # post-commit state labeled M — which only exists once the
+                # caller applies this round's update. Deferring to
+                # start_quorum also lands the announce BEFORE the round's
+                # allreduce barrier, so a healer blocking that barrier can
+                # still reconstruct the generation it needs.
+                self._redundancy_stage_pending = True
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
@@ -2657,6 +2999,21 @@ class Manager:
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
             self._metrics_server = None
+        # redundancy plane first: its worker threads hold no locks the
+        # teardown below needs, and a late shard PUT against a dying peer
+        # is harmless but noisy
+        if self._shard_stager is not None:
+            try:
+                self._shard_stager.shutdown()
+            except Exception:  # noqa: BLE001 — teardown must never raise
+                pass
+            self._shard_stager = None
+        if self._hot_spare is not None:
+            try:
+                self._hot_spare.shutdown()
+            except Exception:  # noqa: BLE001 — teardown must never raise
+                pass
+            self._hot_spare = None
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:
             self._manager.shutdown()

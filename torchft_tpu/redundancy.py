@@ -1,0 +1,1756 @@
+"""Redundancy plane: erasure-coded peer-staged checkpoints + hot spares.
+
+Recovery used to be the last slow path: a heal was a full serial state
+pull from ONE live peer chosen at fault time (~15 s at 1 GB). This plane
+moves the work to steady state — every commit, each replica group leader
+encodes its committed state into ``k`` data + ``m`` parity shards
+(:mod:`torchft_tpu.checkpointing.erasure`, systematic GF(256)
+Reed–Solomon) and stages them across peer shard stores OFF the hot path,
+announcing the shard map to a lighthouse-side :class:`ShardDirectory`
+with the same ``(epoch, seq)`` stale-rejection handshake the serving
+registry and aggregator tier use. On heal, the rejoiner pulls all shards
+in parallel from distinct peers (per-shard failover: a dead or corrupt
+data shard is replaced by parity at decode time) instead of one serial
+full pull; and a **hot spare** (:class:`HotSpare` /
+``Manager(spare=True)`` / ``python -m torchft_tpu.redundancy
+--hot-spare``) shadows the fleet by prefetching every announced shard
+generation so that on a member death the directory promotes it into the
+next quorum with its state already resident — convergence within one
+step.
+
+Placement is pod-aware via the PR 8 aggregator topology: data shards
+land on peers in the owner's own pod (locality — the common reconstruct
+is an intra-pod parallel pull), parity shards land across pods (a whole
+dead pod still leaves ``m`` parity shards elsewhere). Pod identity comes
+from ``TORCHFT_POD``, falling back to the replica's aggregator address
+(``TORCHFT_LIGHTHOUSE_AGGREGATOR``) — the same partition the control
+plane already batches by.
+
+``k == 0`` (the default) disables the plane entirely: no store, no
+directory traffic, and the heal path is byte-identical to the classic
+single/multi-source pull (pinned by tests/test_redundancy.py).
+
+Env contract (docs/operations.md "Fast recovery & hot spares"):
+``TORCHFT_REDUNDANCY_K`` / ``_M`` / ``_DIRECTORY`` / ``_INTERVAL`` /
+``_TIMEOUT_S`` / ``_RETAIN``, plus ``TORCHFT_POD`` for placement.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import pickle
+import queue
+import re
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+import zlib
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .checkpointing._serialization import (
+    flatten_state,
+    payload_memoryview,
+    unflatten_state,
+)
+from .checkpointing.erasure import (
+    decode_shards,
+    encode_shards,
+    shard_crc,
+    shard_length,
+)
+from .observability import MetricsRegistry
+from .retry import RetryPolicy, retry_call
+
+logger = logging.getLogger(__name__)
+
+# --------------------------------------------------------------------------
+# Env contract
+# --------------------------------------------------------------------------
+REDUNDANCY_K_ENV = "TORCHFT_REDUNDANCY_K"
+REDUNDANCY_M_ENV = "TORCHFT_REDUNDANCY_M"
+REDUNDANCY_DIRECTORY_ENV = "TORCHFT_REDUNDANCY_DIRECTORY"
+REDUNDANCY_INTERVAL_ENV = "TORCHFT_REDUNDANCY_INTERVAL"
+REDUNDANCY_TIMEOUT_S_ENV = "TORCHFT_REDUNDANCY_TIMEOUT_S"
+REDUNDANCY_RETAIN_ENV = "TORCHFT_REDUNDANCY_RETAIN"
+POD_ENV = "TORCHFT_POD"
+_AGGREGATOR_ENV = "TORCHFT_LIGHTHOUSE_AGGREGATOR"  # manager.AGGREGATOR_ENV
+
+
+def pod_identity(default: str = "pod0") -> str:
+    """The replica's placement pod: ``TORCHFT_POD`` when set, else derived
+    from the aggregator this replica beats through (the PR 8 pod
+    partition), else ``default`` — a flat fleet is one pod."""
+    pod = os.environ.get(POD_ENV, "").strip()
+    if pod:
+        return pod
+    agg = os.environ.get(_AGGREGATOR_ENV, "").strip()
+    if agg:
+        return "pod-" + re.sub(r"[^A-Za-z0-9_.-]", "-", agg)
+    return default
+
+
+@dataclass
+class RedundancyConfig:
+    """Knobs for the redundancy plane (all overridable via
+    ``TORCHFT_REDUNDANCY_*``). ``k == 0`` disables the plane."""
+
+    k: int = 0  # data shards; 0 = redundancy off
+    m: int = 1  # parity shards
+    directory: str = ""  # ShardDirectory base URL ("" = off)
+    interval: int = 1  # stage every N commits
+    timeout_s: float = 15.0  # per shard-RPC deadline
+    retain: int = 2  # shard generations kept per owner in each store
+    pod: str = ""  # placement pod ("" = pod_identity())
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "RedundancyConfig":
+        def _pick(env: str, key: str, cast: Callable[[str], Any]) -> Any:
+            if key in overrides and overrides[key] is not None:
+                return overrides[key]
+            raw = os.environ.get(env)
+            if raw is None or not raw.strip():
+                return getattr(cls, key)
+            try:
+                return cast(raw.strip())
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"bad {env}={raw!r}: {e}") from e
+
+        cfg = cls(
+            k=_pick(REDUNDANCY_K_ENV, "k", int),
+            m=_pick(REDUNDANCY_M_ENV, "m", int),
+            directory=_pick(REDUNDANCY_DIRECTORY_ENV, "directory", str),
+            interval=_pick(REDUNDANCY_INTERVAL_ENV, "interval", int),
+            timeout_s=_pick(REDUNDANCY_TIMEOUT_S_ENV, "timeout_s", float),
+            retain=_pick(REDUNDANCY_RETAIN_ENV, "retain", int),
+            pod=_pick(POD_ENV, "pod", str),
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.k < 0:
+            raise ValueError(f"invalid {REDUNDANCY_K_ENV}={self.k}: must be >= 0")
+        if self.k:
+            if self.m < 1:
+                raise ValueError(
+                    f"invalid {REDUNDANCY_M_ENV}={self.m}: need >= 1 parity "
+                    "shard when redundancy is on (k > 0)"
+                )
+            if self.k + self.m > 255:
+                raise ValueError(
+                    f"k+m={self.k + self.m} exceeds the GF(256) shard limit"
+                )
+        if self.interval < 1:
+            raise ValueError(
+                f"invalid {REDUNDANCY_INTERVAL_ENV}={self.interval}: must be >= 1"
+            )
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"invalid {REDUNDANCY_TIMEOUT_S_ENV}={self.timeout_s}: must be > 0"
+            )
+        if self.retain < 1:
+            raise ValueError(
+                f"invalid {REDUNDANCY_RETAIN_ENV}={self.retain}: must be >= 1"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.k >= 1 and bool(self.directory)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "k": self.k,
+            "m": self.m,
+            "directory": self.directory,
+            "interval": self.interval,
+            "timeout_s": self.timeout_s,
+            "retain": self.retain,
+            "pod": self.pod,
+        }
+
+
+# --------------------------------------------------------------------------
+# Fault hook (event_injector glue, mirrors serving.set_serve_fault_hook)
+# --------------------------------------------------------------------------
+_fault_hook: Optional[Callable[[str, Dict[str, Any]], Optional[str]]] = None
+_fault_lock = threading.Lock()
+
+
+def set_redundancy_fault_hook(
+    fn: Optional[Callable[[str, Dict[str, Any]], Optional[str]]],
+) -> None:
+    """Install a process-wide redundancy fault hook (test-only).
+
+    ``fn(event, info)`` fires at ``"shard_get"`` (a shard store is about
+    to serve a shard body; info: owner/step/idx/holder) and
+    ``"shard_put"`` (a store is about to accept one). Returning
+    ``"corrupt"`` flips a byte in the served body (the announced crc32
+    then flags it downstream); ``"die"`` drops the connection mid-body —
+    the shapes :meth:`EventInjector.corrupt_shard` and
+    :meth:`EventInjector.kill_shard_source` arm."""
+    global _fault_hook
+    with _fault_lock:
+        _fault_hook = fn
+
+
+def _fire_fault(event: str, info: Dict[str, Any]) -> Optional[str]:
+    with _fault_lock:
+        fn = _fault_hook
+    if fn is None:
+        return None
+    try:
+        return fn(event, info)
+    except Exception:  # noqa: BLE001 — a broken hook must not break the plane
+        logger.exception("redundancy fault hook failed on %s", event)
+        return None
+
+
+# --------------------------------------------------------------------------
+# Committed-state blob codec (spec + raw leaf bytes, erasure-ready)
+# --------------------------------------------------------------------------
+_BLOB_HEADER = struct.Struct("<q")  # pickled-spec length
+
+
+def pack_state_blob(state: Any) -> bytes:
+    """Serialize a committed state pytree into one contiguous erasure
+    input: ``<spec_len><pickled TreeSpecPayload><leaf bytes...>``. Leaves
+    travel as their raw little-endian buffers (the same canonical bytes
+    the HTTP transport streams), so the round-trip is bitwise."""
+    spec, payloads = flatten_state(state, snapshot=True)
+    spec_bytes = pickle.dumps(spec)
+    parts: List[Any] = [_BLOB_HEADER.pack(len(spec_bytes)), spec_bytes]
+    parts.extend(payload_memoryview(p) for p in payloads)
+    return b"".join(parts)
+
+
+def unpack_state_blob(blob: bytes) -> Any:
+    (spec_len,) = _BLOB_HEADER.unpack_from(blob, 0)
+    off = _BLOB_HEADER.size
+    spec = pickle.loads(blob[off : off + spec_len])
+    off += spec_len
+    view = memoryview(blob)
+    payloads: List[Any] = []
+    for meta in spec.leaves:
+        chunk = view[off : off + meta.nbytes]
+        off += meta.nbytes
+        payloads.append(bytes(chunk) if meta.kind == "pickled" else chunk)
+    return unflatten_state(spec, payloads)
+
+
+# --------------------------------------------------------------------------
+# HTTP plumbing (shared shapes with serving.py)
+# --------------------------------------------------------------------------
+def _json_body(handler: BaseHTTPRequestHandler) -> Dict[str, Any]:
+    length = int(handler.headers.get("Content-Length", 0) or 0)
+    raw = handler.rfile.read(length) if length else b"{}"
+    return json.loads(raw.decode() or "{}")
+
+
+def _send_json(
+    handler: BaseHTTPRequestHandler, code: int, obj: Dict[str, Any]
+) -> None:
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _http_json(
+    url: str,
+    payload: Optional[Dict[str, Any]] = None,
+    timeout: float = 5.0,
+) -> Tuple[int, Dict[str, Any]]:
+    """One JSON request; (status, body). 4xx bodies are parsed, not
+    raised — the directory speaks structured 409s."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url,
+        data=data,
+        method="POST" if data is not None else "GET",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode() or "{}")
+        except Exception:  # noqa: BLE001
+            return e.code, {}
+
+
+# --------------------------------------------------------------------------
+# ShardStore — every participating replica runs one; peers PUT/GET shards
+# --------------------------------------------------------------------------
+class ShardStore:
+    """In-memory peer shard depot with a ranged, resumable GET.
+
+    Bodies are raw shard bytes; integrity rides the DIRECTORY's announced
+    crc32 per shard (same checksum family as the ranged HTTP transport's
+    trailers), so a flipped byte anywhere between encode and decode is
+    detected by the puller regardless of which hop corrupted it.
+    ``?offset=N`` resumes a torn pull from the last received byte.
+    ``throttle_mb_s`` rate-limits each GET body — the bench's stand-in
+    for a peer NIC egress cap on loopback."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        retain: int = 2,
+        throttle_mb_s: Optional[float] = None,
+    ) -> None:
+        self.replica_id = replica_id
+        self._retain = max(1, int(retain))
+        self._throttle_mb_s = throttle_mb_s
+        self._lock = threading.Lock()
+        # (owner, step) -> {idx: bytes}
+        self._shards: Dict[Tuple[str, int], Dict[int, bytes]] = {}
+        self._counters: Dict[str, int] = {
+            "puts_total": 0,
+            "gets_total": 0,
+            "bytes_stored": 0,
+        }
+
+        store = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("shard_store: " + fmt, *args)
+
+            def do_PUT(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    parsed = store._parse_path(self.path)
+                    if parsed is None:
+                        self.send_error(404)
+                        return
+                    owner, step, idx = parsed
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = self.rfile.read(length)
+                    verdict = _fire_fault(
+                        "shard_put",
+                        {"owner": owner, "step": step, "idx": idx,
+                         "holder": store.replica_id},
+                    )
+                    if verdict == "die":
+                        self.connection.close()
+                        return
+                    store.put(owner, step, idx, body)
+                    _send_json(self, 200, {"ok": True, "crc": shard_crc(body)})
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("shard_store PUT failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            do_POST = do_PUT  # noqa: N815 — same staging contract
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    path, _, query = self.path.partition("?")
+                    if path == "/redundancy/store/status":
+                        _send_json(self, 200, store.status())
+                        return
+                    parsed = store._parse_path(path)
+                    if parsed is None:
+                        self.send_error(404)
+                        return
+                    owner, step, idx = parsed
+                    body = store.get(owner, step, idx)
+                    if body is None:
+                        self.send_error(404, "no such shard")
+                        return
+                    offset = 0
+                    for part in query.split("&"):
+                        if part.startswith("offset="):
+                            offset = max(0, int(part[7:]))
+                    verdict = _fire_fault(
+                        "shard_get",
+                        {"owner": owner, "step": step, "idx": idx,
+                         "holder": store.replica_id},
+                    )
+                    if verdict == "corrupt":
+                        flipped = bytearray(body)
+                        flipped[len(flipped) // 2] ^= 0x01
+                        body = bytes(flipped)
+                    body = body[offset:]
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    if verdict == "die":
+                        # serve half the body then drop the socket: the
+                        # puller must resume from its last received byte
+                        # or fail over to parity
+                        self.wfile.write(body[: max(1, len(body) // 2)])
+                        self.wfile.flush()
+                        self.connection.close()
+                        return
+                    store._write_throttled(self.wfile, body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("shard_store GET failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name=f"torchft_shard_store_{replica_id}",
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @staticmethod
+    def _parse_path(path: str) -> Optional[Tuple[str, int, int]]:
+        m = re.fullmatch(r"/redundancy/shard/([^/]+)/(\d+)/(\d+)", path)
+        if not m:
+            return None
+        return m.group(1), int(m.group(2)), int(m.group(3))
+
+    def _write_throttled(self, wfile: Any, body: bytes) -> None:
+        if not self._throttle_mb_s:
+            wfile.write(body)
+            return
+        budget = self._throttle_mb_s * 1024 * 1024
+        slice_n = max(64 * 1024, int(budget * 0.05))  # ~50 ms slices
+        # memoryview slices: a bytes slice per wakeup would copy the whole
+        # body once over; with many throttled streams sharing one core
+        # that copy (and the wakeup storm a finer cadence causes) is pure
+        # contention. Pacing stays exact either way — the sleep target is
+        # computed from total elapsed, so overshoot self-corrects.
+        mv = memoryview(body)
+        off = 0
+        start = time.monotonic()
+        while off < len(body):
+            wfile.write(mv[off : off + slice_n])
+            off += slice_n
+            ahead = off / budget - (time.monotonic() - start)
+            if ahead > 0:
+                time.sleep(ahead)
+
+    # -- storage -----------------------------------------------------------
+    def put(self, owner: str, step: int, idx: int, body: bytes) -> None:
+        with self._lock:
+            self._shards.setdefault((owner, step), {})[idx] = body
+            self._counters["puts_total"] += 1
+            steps = sorted(s for (o, s) in self._shards if o == owner)
+            for stale in steps[: -self._retain]:
+                self._shards.pop((owner, stale), None)
+            self._counters["bytes_stored"] = sum(
+                len(b) for gen in self._shards.values() for b in gen.values()
+            )
+
+    def get(self, owner: str, step: int, idx: int) -> Optional[bytes]:
+        with self._lock:
+            self._counters["gets_total"] += 1
+            return self._shards.get((owner, step), {}).get(idx)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replica_id": self.replica_id,
+                "generations": [
+                    {"owner": o, "step": s, "shards": sorted(g)}
+                    for (o, s), g in sorted(self._shards.items())
+                ],
+                "counters": dict(self._counters),
+            }
+
+    def shutdown(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+
+def put_shard(
+    store_url: str, owner: str, step: int, idx: int, body: bytes,
+    timeout: float,
+) -> None:
+    req = urllib.request.Request(
+        f"{store_url}/redundancy/shard/{owner}/{step}/{idx}",
+        data=body,
+        method="PUT",
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        resp = json.loads(r.read().decode() or "{}")
+    if resp.get("crc") != shard_crc(body):
+        raise IOError(
+            f"shard {owner}/{step}/{idx} corrupted in flight to {store_url}"
+        )
+
+
+def get_shard_into(
+    dest: Any, store_url: str, owner: str, step: int, idx: int,
+    nbytes: int, expect_crc: int, timeout: float, max_resumes: int = 3,
+) -> None:
+    """Pull one shard straight into a preallocated writable buffer.
+
+    This is the scatter-gather half of the parallel reconstruct: data
+    shards land at their final offset in the decoded blob, so the common
+    all-data-shards-alive case never concatenates — at GB state sizes
+    each avoided full-blob pass is seconds of fault+copy the healer does
+    not pay. The crc32 streams with the transfer (one running update per
+    chunk), so on a throttled or remote holder the checksum hides under
+    the wire wait instead of adding a tail pass. Ranged resume as in
+    :func:`get_shard`: a torn body picks up from the last received byte
+    (``?offset=N``) instead of restarting."""
+    view = memoryview(dest)
+    if view.nbytes < nbytes:
+        raise ValueError(
+            f"shard buffer holds {view.nbytes} bytes, shard is {nbytes}"
+        )
+    got = 0
+    crc = 0
+    resumes = 0
+    while True:
+        url = f"{store_url}/redundancy/shard/{owner}/{step}/{idx}"
+        if got:
+            url += f"?offset={got}"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                while got < nbytes:
+                    n = r.readinto(
+                        view[got : got + min(4 << 20, nbytes - got)]
+                    )
+                    if not n:
+                        break
+                    crc = zlib.crc32(view[got : got + n], crc)
+                    got += n
+        except (
+            urllib.error.URLError, ConnectionError, IOError,
+            http.client.HTTPException,
+        ):
+            if got >= nbytes or resumes >= max_resumes:
+                raise
+            resumes += 1
+            continue
+        if got < nbytes and resumes < max_resumes:
+            resumes += 1
+            continue
+        break
+    if got < nbytes:
+        raise IOError(
+            f"shard {owner}/{step}/{idx} from {store_url} truncated at "
+            f"{got}/{nbytes} bytes"
+        )
+    if crc & 0xFFFFFFFF != expect_crc:
+        raise IOError(
+            f"shard {owner}/{step}/{idx} from {store_url} failed crc32"
+        )
+
+
+def get_shard(
+    store_url: str, owner: str, step: int, idx: int, nbytes: int,
+    expect_crc: int, timeout: float, max_resumes: int = 3,
+) -> bytes:
+    """Pull one shard as a standalone bytes body (see
+    :func:`get_shard_into` for the in-place variant the parallel
+    reconstruct uses)."""
+    buf = bytearray(nbytes)
+    get_shard_into(
+        buf, store_url, owner, step, idx, nbytes, expect_crc,
+        timeout=timeout, max_resumes=max_resumes,
+    )
+    return bytes(buf)
+
+
+# --------------------------------------------------------------------------
+# ShardDirectory — lives next to the lighthouse; (epoch, seq) stale-proof
+# --------------------------------------------------------------------------
+class ShardDirectory:
+    """Tracks where every replica's shard generations live and promotes
+    hot spares when an owner dies.
+
+    Stale-instance protection reuses the aggregator/serving ``(epoch,
+    seq)`` pattern: the directory mints a fresh ``epoch`` at startup;
+    announces carry the epoch granted at registration plus a per-owner
+    monotonic ``seq`` and a strictly increasing ``step``. A replayed or
+    delayed announce — or one from a pre-restart incarnation — is
+    rejected with a structured 409, never merged.
+
+    Death detection is twofold: the lighthouse ``/health`` poll (an
+    ``excluded`` replica is dead for promotion purposes, gated through
+    :func:`healthwatch.spare_eligible` on the candidate side) and an
+    announce-gap detector — an owner whose newest shard generation has
+    fallen ``gap_steps`` behind the fleet maximum AND gone quiet for
+    ``dead_after_s`` is presumed dead. Promotions are monotonic: each
+    gets the next ``promote_seq``, a spare is never un-promoted, and a
+    dead owner is never promoted onto twice."""
+
+    def __init__(
+        self,
+        lighthouse_addr: Optional[str] = None,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        poll_s: float = 0.25,
+        dead_after_s: float = 2.0,
+        gap_steps: int = 2,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ) -> None:
+        self._lock = threading.Lock()
+        self.epoch = uuid.uuid4().hex[:12]
+        self._poll_s = poll_s
+        self._dead_after_s = dead_after_s
+        self._gap_steps = max(1, int(gap_steps))
+        self._lighthouse_addr = lighthouse_addr
+        self._health_fn = health_fn
+        # replica_id -> {pod, store_url, spare, registered_at}
+        self._peers: Dict[str, Dict[str, Any]] = {}
+        self._registered: Dict[str, str] = {}  # replica_id -> epoch granted
+        # owner -> latest announce entry
+        self._entries: Dict[str, Dict[str, Any]] = {}
+        self._health_states: Dict[str, str] = {}
+        self._excluded: set = set()
+        self._dead: set = set()
+        # spare_id -> promotion record; plus global monotonic counter
+        self._promotions: Dict[str, Dict[str, Any]] = {}
+        self._promote_seq = 0
+        self._replaced: set = set()  # owners already promoted onto
+        self._counters: Dict[str, int] = {
+            "announce_total": 0,
+            "announce_rejected_total": 0,
+            "promotions_total": 0,
+            "dead_marked_total": 0,
+        }
+        self._metrics = MetricsRegistry()
+        self._stop = threading.Event()
+
+        directory = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                logger.debug("shard_directory: " + fmt, *args)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    path = self.path.partition("?")[0]
+                    if path == "/redundancy/directory":
+                        _send_json(self, 200, directory.directory())
+                    elif path == "/redundancy/peers":
+                        _send_json(self, 200, directory.peers())
+                    elif path.startswith("/redundancy/spare/"):
+                        sid = path[len("/redundancy/spare/"):]
+                        _send_json(self, 200, directory.spare_status(sid))
+                    elif path == "/redundancy/status":
+                        _send_json(self, 200, directory.status())
+                    elif path in ("/metrics", "/"):
+                        directory._refresh_metrics()
+                        body = directory._metrics.render().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "text/plain; version=0.0.4"
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self.send_error(404)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("shard_directory GET failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_POST(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    path = self.path.partition("?")[0]
+                    body = _json_body(self)
+                    if path == "/redundancy/register":
+                        code, resp = directory.register(
+                            str(body["replica_id"]),
+                            str(body.get("pod", "pod0")),
+                            str(body.get("store_url", "")),
+                            bool(body.get("spare", False)),
+                        )
+                    elif path == "/redundancy/announce":
+                        code, resp = directory.announce(body)
+                    elif path == "/redundancy/dead":
+                        code, resp = directory.mark_dead(
+                            str(body["replica_id"])
+                        )
+                    else:
+                        self.send_error(404)
+                        return
+                    _send_json(self, code, resp)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("shard_directory POST failed")
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name="torchft_shard_directory",
+        )
+        self._thread.start()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, daemon=True,
+            name="torchft_shard_directory_tick",
+        )
+        self._tick_thread.start()
+
+    # -- public api --------------------------------------------------------
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def register(
+        self, replica_id: str, pod: str, store_url: str, spare: bool
+    ) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            self._registered[replica_id] = self.epoch
+            self._peers[replica_id] = {
+                "pod": pod,
+                "store_url": store_url,
+                "spare": bool(spare),
+                "registered_at": time.time(),
+            }
+            # a re-registering replica is alive again by definition; a
+            # PROMOTED spare keeps its promotion record (monotonicity)
+            self._dead.discard(replica_id)
+            return 200, {"epoch": self.epoch}
+
+    def announce(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        try:
+            owner = str(body["replica_id"])
+            epoch = str(body["epoch"])
+            seq = int(body["seq"])
+            step = int(body["step"])
+            k = int(body["k"])
+            m = int(body["m"])
+            data_len = int(body["data_len"])
+            shards = list(body["shards"])
+            for s in shards:
+                s["idx"] = int(s["idx"])
+                s["crc"] = int(s["crc"])
+                s["url"] = str(s["url"])
+                s["holder"] = str(s.get("holder", ""))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"error": f"malformed announce: {e}"}
+        with self._lock:
+            self._counters["announce_total"] += 1
+            if epoch != self.epoch:
+                self._counters["announce_rejected_total"] += 1
+                return 409, {"error": "stale_epoch", "epoch": self.epoch}
+            prior = self._entries.get(owner)
+            if prior is not None and seq <= prior["seq"]:
+                self._counters["announce_rejected_total"] += 1
+                return 409, {"error": "stale_seq", "have_seq": prior["seq"]}
+            if prior is not None and step <= prior["step"]:
+                # shard generations are strictly monotone per owner
+                self._counters["announce_rejected_total"] += 1
+                return 409, {"error": "stale_step", "have_step": prior["step"]}
+            if owner in self._replaced:
+                # a dead owner already promoted onto can't resurrect its
+                # pre-death shard map into the new fleet
+                self._counters["announce_rejected_total"] += 1
+                return 409, {"error": "stale_owner"}
+            self._entries[owner] = {
+                "seq": seq,
+                "step": step,
+                "k": k,
+                "m": m,
+                "data_len": data_len,
+                "shards": shards,
+                "announced_at": time.time(),
+            }
+            return 200, {"ok": True}
+
+    def mark_dead(self, replica_id: str) -> Tuple[int, Dict[str, Any]]:
+        """Explicit death notice (ops / chaos harness); the same path the
+        health poll and announce-gap detector feed."""
+        with self._lock:
+            if replica_id not in self._dead:
+                self._dead.add(replica_id)
+                self._counters["dead_marked_total"] += 1
+        self._maybe_promote()
+        return 200, {"ok": True, "dead": sorted(self._dead)}
+
+    def directory(self) -> Dict[str, Any]:
+        with self._lock:
+            latest = self._latest_locked()
+            return {
+                "epoch": self.epoch,
+                "entries": {
+                    o: dict(e) for o, e in self._entries.items()
+                },
+                "latest": latest,
+                "peers": self._peers_locked(),
+                "dead": sorted(self._dead),
+                "promotions": {
+                    s: dict(p) for s, p in self._promotions.items()
+                },
+            }
+
+    def peers(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"epoch": self.epoch, "peers": self._peers_locked()}
+
+    def spare_status(self, spare_id: str) -> Dict[str, Any]:
+        with self._lock:
+            promo = self._promotions.get(spare_id)
+            return {
+                "epoch": self.epoch,
+                "spare_id": spare_id,
+                "registered": spare_id in self._registered,
+                "promote": promo is not None,
+                "promotion": dict(promo) if promo else None,
+            }
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "epoch": self.epoch,
+                "entries": {o: e["step"] for o, e in self._entries.items()},
+                "peers": sorted(self._peers),
+                "spares": sorted(
+                    r for r, p in self._peers.items() if p["spare"]
+                ),
+                "dead": sorted(self._dead),
+                "promotions": {
+                    s: dict(p) for s, p in self._promotions.items()
+                },
+                "counters": dict(self._counters),
+            }
+
+    def apply_health(self, health: Dict[str, Any]) -> None:
+        """Fold one lighthouse /health summary: excluded replicas are
+        dead for promotion purposes; per-replica states gate which spares
+        are promotable (healthwatch.spare_eligible)."""
+        replicas = health.get("replicas", {}) or {}
+        with self._lock:
+            self._health_states = {
+                str(rid): str(info.get("state", "ok"))
+                for rid, info in replicas.items()
+            }
+            newly = set()
+            for rid in health.get("excluded", []) or []:
+                rid = str(rid)
+                self._excluded.add(rid)
+                if rid in self._registered and rid not in self._dead:
+                    newly.add(rid)
+            for rid in newly:
+                self._dead.add(rid)
+                self._counters["dead_marked_total"] += 1
+        if newly:
+            self._maybe_promote()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+
+    # -- internals ---------------------------------------------------------
+    def _peers_locked(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "replica_id": rid,
+                "pod": p["pod"],
+                "store_url": p["store_url"],
+                "spare": p["spare"],
+            }
+            for rid, p in sorted(self._peers.items())
+        ]
+
+    def _latest_locked(self) -> Optional[List[Any]]:
+        live = [
+            (e["step"], o)
+            for o, e in self._entries.items()
+            if o not in self._dead and o not in self._replaced
+        ] or [(e["step"], o) for o, e in self._entries.items()]
+        if not live:
+            return None
+        step, owner = max(live)
+        return [owner, step]
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                health = self._poll_health()
+                if health is not None:
+                    self.apply_health(health)
+            except Exception:  # noqa: BLE001 — keep ticking on poll failure
+                logger.debug("shard_directory health poll failed",
+                             exc_info=True)
+            try:
+                self._detect_gaps()
+                self._maybe_promote()
+            except Exception:  # noqa: BLE001
+                logger.exception("shard_directory tick failed")
+
+    def _poll_health(self) -> Optional[Dict[str, Any]]:
+        if self._health_fn is not None:
+            return self._health_fn()
+        if self._lighthouse_addr is None:
+            return None
+        from .coordination import LighthouseClient  # lazy: import cycle
+
+        return LighthouseClient(
+            self._lighthouse_addr, connect_timeout=2.0
+        ).health()
+
+    def _detect_gaps(self) -> None:
+        """An owner whose shard generation trails the fleet maximum by
+        ``gap_steps`` AND has announced nothing for ``dead_after_s`` is
+        presumed dead — the fleet committed on without it."""
+        now = time.time()
+        with self._lock:
+            if len(self._entries) < 2:
+                return
+            max_step = max(e["step"] for e in self._entries.values())
+            newly = set()
+            for owner, e in self._entries.items():
+                if owner in self._dead or owner in self._replaced:
+                    continue
+                if (
+                    e["step"] <= max_step - self._gap_steps
+                    and now - e["announced_at"] > self._dead_after_s
+                ):
+                    newly.add(owner)
+            for owner in newly:
+                self._dead.add(owner)
+                self._counters["dead_marked_total"] += 1
+                logger.info(
+                    "shard_directory: presuming %s dead (generation %s "
+                    "vs fleet max %s, quiet %.1fs)",
+                    owner, self._entries[owner]["step"], max_step,
+                    now - self._entries[owner]["announced_at"],
+                )
+
+    def _maybe_promote(self) -> None:
+        from .healthwatch import spare_eligible
+
+        with self._lock:
+            pending = [
+                o for o in sorted(self._dead)
+                if o not in self._replaced
+                and not self._peers.get(o, {}).get("spare", False)
+            ]
+            if not pending:
+                return
+            promoted_spares = set(self._promotions)
+            for owner in pending:
+                candidate = next(
+                    (
+                        rid
+                        for rid, p in sorted(self._peers.items())
+                        if p["spare"]
+                        and rid not in promoted_spares
+                        and rid not in self._dead
+                        and spare_eligible(
+                            self._health_states.get(rid, "ok")
+                        )
+                    ),
+                    None,
+                )
+                if candidate is None:
+                    return
+                self._promote_seq += 1
+                self._promotions[candidate] = {
+                    "promote_seq": self._promote_seq,
+                    "replaces": owner,
+                    "at": time.time(),
+                }
+                self._replaced.add(owner)
+                promoted_spares.add(candidate)
+                self._counters["promotions_total"] += 1
+                logger.info(
+                    "shard_directory: promoting spare %s to replace %s "
+                    "(promote_seq=%d)",
+                    candidate, owner, self._promote_seq,
+                )
+
+    def _refresh_metrics(self) -> None:
+        with self._lock:
+            n_entries = len(self._entries)
+            n_spares = sum(1 for p in self._peers.values() if p["spare"])
+            n_shards = sum(
+                len(e["shards"]) for e in self._entries.values()
+            )
+            latest = self._latest_locked()
+            counters = dict(self._counters)
+        m = self._metrics
+        m.gauge_set(
+            "redundancy_entries", float(n_entries),
+            "Owners with a live shard generation in the directory.",
+        )
+        m.gauge_set(
+            "redundancy_spares", float(n_spares),
+            "Registered hot spares shadowing the fleet.",
+        )
+        m.gauge_set(
+            "redundancy_shards_tracked", float(n_shards),
+            "Total shards across all live generations.",
+        )
+        m.gauge_set(
+            "redundancy_latest_step",
+            float(latest[1]) if latest else -1.0,
+            "Step of the newest announced shard generation.",
+        )
+        for name, val in counters.items():
+            m.counter_set(f"redundancy_{name}", float(val))
+
+
+class DirectoryClient:
+    """Thin retrying client for the ShardDirectory (RegistryClient
+    shape): transport errors retry through the jittered-backoff policy;
+    structured 4xx responses are returned, not retried."""
+
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 5.0,
+        policy: Optional[RetryPolicy] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.policy = policy or RetryPolicy.from_env()
+
+    def _call(
+        self, path: str, payload: Optional[Dict[str, Any]] = None
+    ) -> Tuple[int, Dict[str, Any]]:
+        def attempt(remaining: float) -> Tuple[int, Dict[str, Any]]:
+            return _http_json(
+                f"{self.base_url}{path}",
+                payload,
+                timeout=min(self.timeout, max(remaining, 0.05)),
+            )
+
+        return retry_call(
+            attempt,
+            policy=self.policy,
+            timeout=self.timeout,
+            retryable=(OSError, TimeoutError, ConnectionError, ValueError),
+        )
+
+    def register(
+        self, replica_id: str, pod: str, store_url: str, spare: bool = False
+    ) -> str:
+        code, resp = self._call(
+            "/redundancy/register",
+            {
+                "replica_id": replica_id,
+                "pod": pod,
+                "store_url": store_url,
+                "spare": spare,
+            },
+        )
+        if code != 200:
+            raise IOError(f"shard directory register failed: {code} {resp}")
+        return str(resp["epoch"])
+
+    def announce(self, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        return self._call("/redundancy/announce", body)
+
+    def get_directory(self) -> Dict[str, Any]:
+        code, resp = self._call("/redundancy/directory")
+        if code != 200:
+            raise IOError(f"shard directory fetch failed: {code}")
+        return resp
+
+    def peers(self) -> List[Dict[str, Any]]:
+        code, resp = self._call("/redundancy/peers")
+        if code != 200:
+            raise IOError(f"shard directory peers failed: {code}")
+        return list(resp["peers"])
+
+    def spare_status(self, spare_id: str) -> Dict[str, Any]:
+        code, resp = self._call(f"/redundancy/spare/{spare_id}")
+        if code != 200:
+            raise IOError(f"spare status failed: {code}")
+        return resp
+
+    def mark_dead(self, replica_id: str) -> None:
+        self._call("/redundancy/dead", {"replica_id": replica_id})
+
+
+# --------------------------------------------------------------------------
+# Placement — pod-aware (PR 8 aggregator topology)
+# --------------------------------------------------------------------------
+def plan_placement(
+    peers: List[Dict[str, Any]],
+    own_id: str,
+    own_pod: str,
+    k: int,
+    m: int,
+) -> Optional[List[Dict[str, Any]]]:
+    """Assign each of the ``k + m`` shards a holder peer.
+
+    Data shards prefer peers in the OWNER's pod (the common reconstruct
+    is an intra-pod parallel pull at pod-local bandwidth); parity shards
+    prefer peers in OTHER pods (a whole lost pod still leaves parity
+    elsewhere). Spares and the owner itself never hold shards — the
+    entire point is surviving the owner's death, and a spare must stay
+    payload-free so promotion is instant. Fewer holders than shards wraps
+    round-robin (distinctness is best-effort, logged by the caller);
+    zero eligible holders returns None."""
+    eligible = [
+        p for p in peers
+        if p["replica_id"] != own_id and not p.get("spare", False)
+        and p.get("store_url")
+    ]
+    if not eligible:
+        return None
+    in_pod = [p for p in eligible if p.get("pod") == own_pod]
+    out_pod = [p for p in eligible if p.get("pod") != own_pod]
+    data_pref = (in_pod + out_pod) or eligible
+    parity_pref = (out_pod + in_pod) or eligible
+    plan: List[Dict[str, Any]] = []
+    for i in range(k):
+        plan.append(data_pref[i % len(data_pref)])
+    for j in range(m):
+        plan.append(parity_pref[j % len(parity_pref)])
+    return plan
+
+
+# --------------------------------------------------------------------------
+# ShardStager — encodes + stages committed state off the hot path
+# --------------------------------------------------------------------------
+class ShardStager:
+    """Per-replica staging engine.
+
+    The hot path pays only :func:`pack_state_blob` (one snapshot copy of
+    the committed leaves — the same copy a standby snapshot already
+    makes) plus a queue put; erasure encode, peer PUTs, and the directory
+    announce all run on a background worker. Only the newest pending
+    generation is kept: a slow fleet drops intermediate generations
+    rather than falling behind (the directory's strict step monotonicity
+    makes the skip safe)."""
+
+    def __init__(
+        self,
+        cfg: RedundancyConfig,
+        replica_id: str,
+        on_metric: Optional[Callable[[str, float], None]] = None,
+        store: Optional[ShardStore] = None,
+    ) -> None:
+        if not cfg.enabled:
+            raise ValueError("ShardStager requires an enabled RedundancyConfig")
+        self.cfg = cfg
+        self.replica_id = replica_id
+        self.pod = cfg.pod or pod_identity()
+        self._on_metric = on_metric or (lambda name, value: None)
+        self.store = store or ShardStore(replica_id, retain=cfg.retain)
+        self._client = DirectoryClient(cfg.directory, timeout=cfg.timeout_s)
+        self._epoch: Optional[str] = None
+        self._seq = 0
+        self._commits_seen = 0
+        self._pending: "queue.Queue[Optional[Tuple[int, bytes]]]" = (
+            queue.Queue(maxsize=1)
+        )
+        self._lock = threading.Lock()
+        self._last_staged_step = -1
+        self._wrap_warned = False
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._worker_loop, daemon=True,
+            name=f"torchft_shard_stager_{replica_id}",
+        )
+        self._worker.start()
+        self.register()
+
+    def register(self) -> None:
+        try:
+            self._epoch = self._client.register(
+                self.replica_id, self.pod, self.store.url, spare=False
+            )
+        except Exception:  # noqa: BLE001 — directory may come up later
+            logger.warning(
+                "shard stager %s could not register with directory %s yet",
+                self.replica_id, self.cfg.directory,
+            )
+            self._epoch = None
+
+    # -- hot path ----------------------------------------------------------
+    def stage(self, step: int, state: Any) -> bool:
+        """Snapshot + enqueue one committed generation (hot path). Returns
+        False when skipped (interval gating or a full queue with the same
+        generation racing)."""
+        self._commits_seen += 1
+        if (self._commits_seen - 1) % self.cfg.interval != 0:
+            self._on_metric("shard_stage_skipped", 1)
+            return False
+        t0 = time.monotonic()
+        blob = pack_state_blob(state)
+        self._on_metric("shard_stage_snapshot_s", time.monotonic() - t0)
+        # newest-wins: drop a stale pending generation instead of queueing
+        try:
+            while True:
+                self._pending.get_nowait()
+                self._on_metric("shard_stage_dropped", 1)
+        except queue.Empty:
+            pass
+        self._pending.put((int(step), blob))
+        return True
+
+    # -- worker ------------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._pending.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                return
+            step, blob = item
+            try:
+                self._stage_one(step, blob)
+            except Exception:  # noqa: BLE001 — staging is advisory
+                logger.exception(
+                    "shard staging failed for step %s (advisory)", step
+                )
+                self._on_metric("shard_stage_failed", 1)
+
+    def _stage_one(self, step: int, blob: bytes) -> None:
+        cfg = self.cfg
+        t0 = time.monotonic()
+        if self._epoch is None:
+            self.register()
+            if self._epoch is None:
+                self._on_metric("shard_stage_failed", 1)
+                return
+        peers = self._client.peers()
+        plan = plan_placement(peers, self.replica_id, self.pod, cfg.k, cfg.m)
+        if plan is None:
+            logger.info(
+                "no eligible shard holders yet for %s step %s — staging "
+                "skipped", self.replica_id, step,
+            )
+            self._on_metric("shard_stage_failed", 1)
+            return
+        holders = {p["replica_id"] for p in plan}
+        if len(holders) < cfg.k + cfg.m and not self._wrap_warned:
+            self._wrap_warned = True
+            logger.warning(
+                "only %d distinct shard holders for k+m=%d — placement "
+                "wraps; distinct-peer durability degraded until the fleet "
+                "grows", len(holders), cfg.k + cfg.m,
+            )
+        t_enc = time.monotonic()
+        shards = encode_shards(blob, cfg.k, cfg.m)
+        self._on_metric("shard_encode_s", time.monotonic() - t_enc)
+        # per-shard holder failover: a dead peer must not sink the whole
+        # generation (the exact moment staging matters most is right after
+        # a member died). Each shard tries its planned holder, then every
+        # other distinct live holder; the generation announces whatever
+        # subset landed as long as ANY k shards survive — decode needs no
+        # more. Doubling-up on one holder degrades distinct-peer
+        # durability, which the wrap warning above already covers.
+        distinct = list({p["replica_id"]: p for p in plan}.values())
+        down: set = set()
+        entries = []
+        for idx, (body, peer) in enumerate(zip(shards, plan)):
+            placed = None
+            candidates = [peer] + [
+                p for p in distinct if p["replica_id"] != peer["replica_id"]
+            ]
+            for cand in candidates:
+                if cand["replica_id"] in down:
+                    continue
+                try:
+                    put_shard(
+                        cand["store_url"], self.replica_id, step, idx,
+                        body, timeout=cfg.timeout_s,
+                    )
+                    placed = cand
+                    break
+                except Exception:  # noqa: BLE001 — try the next holder
+                    down.add(cand["replica_id"])
+                    self._on_metric("shard_put_failed", 1)
+            if placed is None:
+                continue
+            entries.append(
+                {
+                    "idx": idx,
+                    "holder": placed["replica_id"],
+                    "url": placed["store_url"],
+                    "crc": shard_crc(body),
+                }
+            )
+        if len(entries) < cfg.k:
+            logger.warning(
+                "only %d/%d shards placed for step %s (< k=%d) — "
+                "generation dropped", len(entries), cfg.k + cfg.m, step,
+                cfg.k,
+            )
+            self._on_metric("shard_stage_failed", 1)
+            return
+        self._seq += 1
+        body = {
+            "replica_id": self.replica_id,
+            "epoch": self._epoch,
+            "seq": self._seq,
+            "step": step,
+            "k": cfg.k,
+            "m": cfg.m,
+            "data_len": len(blob),
+            "shards": entries,
+        }
+        code, resp = self._client.announce(body)
+        if code == 409 and resp.get("error") == "stale_epoch":
+            # directory restarted: re-register and replay once
+            self.register()
+            if self._epoch is not None:
+                body["epoch"] = self._epoch
+                code, resp = self._client.announce(body)
+        if code != 200:
+            logger.warning(
+                "shard announce rejected for step %s: %s", step, resp
+            )
+            self._on_metric("shard_announce_rejected", 1)
+            return
+        with self._lock:
+            self._last_staged_step = step
+        self._on_metric("shards_staged", len(entries))
+        self._on_metric("shard_stage_bytes", float(len(blob)))
+        self._on_metric("shard_stage_s", time.monotonic() - t0)
+
+    # -- introspection / teardown -----------------------------------------
+    def last_staged_step(self) -> int:
+        with self._lock:
+            return self._last_staged_step
+
+    def wait_staged(self, step: int, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.last_staged_step() >= step:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._pending.put_nowait(None)
+        except queue.Full:
+            pass
+        self.store.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Parallel reconstruct — the heal-path fast mode
+# --------------------------------------------------------------------------
+def reconstruct_state(
+    directory_url: str,
+    owner: Optional[str] = None,
+    step: Optional[int] = None,
+    timeout: float = 30.0,
+    on_event: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    max_workers: int = 8,
+) -> Tuple[int, Any, Dict[str, Any]]:
+    """Pull all shards of one generation in parallel from their distinct
+    holders and decode.
+
+    Per-shard failover, not per-transfer: every shard slot that fails
+    (dead holder, torn pull past its resume budget, crc32 mismatch) is
+    simply marked missing — the decode succeeds from ANY ``k`` surviving
+    shards, so up to ``m`` holder faults cost nothing but the parity
+    math. Returns ``(step, state, stats)``; raises when the directory has
+    no generation or fewer than ``k`` shards survive.
+
+    ``step`` targets the exact generation a heal needs: announces ride an
+    async worker off the commit hot path, so a heal racing a fresh commit
+    can observe the directory a few milliseconds stale. With a target
+    step the selection polls briefly until ANY live owner's newest
+    announced generation is that step (every owner's generation at a
+    given step is the same committed fleet state); on deadline it falls
+    back to the newest generation and lets the caller's step check decide
+    whether it is usable."""
+
+    def _emit(kind: str, info: Dict[str, Any]) -> None:
+        if on_event is not None:
+            try:
+                on_event(kind, info)
+            except Exception:  # noqa: BLE001 — advisory
+                logger.debug("reconstruct on_event failed", exc_info=True)
+
+    t0 = time.monotonic()
+    client = DirectoryClient(directory_url, timeout=min(timeout, 10.0))
+    owner_arg = owner
+    settle = min(2.0, max(0.25, timeout * 0.1)) if step is not None else 0.0
+    entry: Optional[Dict[str, Any]] = None
+    while True:
+        d = client.get_directory()
+        entries = d.get("entries", {})
+        timed_out = time.monotonic() - t0 >= settle
+        if owner_arg is not None:
+            entry = entries.get(owner_arg)
+            if entry is None:
+                raise IOError(
+                    f"shard directory has no generation for {owner_arg!r}"
+                )
+            owner = owner_arg
+            if step is None or int(entry["step"]) == int(step) or timed_out:
+                break
+        else:
+            if step is not None:
+                dead = set(d.get("dead", []) or [])
+                match = sorted(
+                    o
+                    for o, e in entries.items()
+                    if int(e["step"]) == int(step) and o not in dead
+                )
+                if match:
+                    owner, entry = match[0], entries[match[0]]
+                    break
+            if step is None or timed_out:
+                latest = d.get("latest")
+                if latest is None:
+                    raise IOError(
+                        "shard directory has no generations to reconstruct"
+                    )
+                owner = str(latest[0])
+                entry = entries.get(owner)
+                if entry is None:
+                    raise IOError(
+                        f"shard directory has no generation for {owner!r}"
+                    )
+                break
+        time.sleep(0.02)
+    k, m = int(entry["k"]), int(entry["m"])
+    step = int(entry["step"])
+    data_len = int(entry["data_len"])
+    slen = shard_length(data_len, k)
+    slots: List[Optional[Any]] = [None] * (k + m)
+    # scatter-gather: the k data shards of a systematic code ARE the blob,
+    # so each data fetch lands directly at its final offset in one
+    # preallocated buffer — when all data shards verify, the blob is
+    # already contiguous and the decode is a no-op (no join pass, no
+    # second allocation; at GB sizes each avoided pass is seconds).
+    # Parity shards get their own small buffers and only feed the GF
+    # repair when a data shard is missing or corrupt.
+    blob = bytearray(k * slen)
+    blob_mv = memoryview(blob)
+    stats = {
+        "owner": owner,
+        "step": step,
+        "k": k,
+        "m": m,
+        "bytes": data_len,
+        "shards_ok": 0,
+        "shards_failed": 0,
+        "shards_corrupt": 0,
+    }
+
+    def _fetch(spec: Dict[str, Any]) -> Tuple[int, Optional[Any], str]:
+        idx = int(spec["idx"])
+        dest: Any = (
+            blob_mv[idx * slen : (idx + 1) * slen]
+            if idx < k
+            else bytearray(slen)
+        )
+        try:
+            get_shard_into(
+                dest, spec["url"], owner, step, idx, slen,
+                int(spec["crc"]), timeout=timeout,
+            )
+            return idx, dest, "ok"
+        except IOError as e:
+            kind = "corrupt" if "crc32" in str(e) else "failed"
+            return idx, None, kind
+        except Exception:  # noqa: BLE001
+            return idx, None, "failed"
+
+    shard_specs = sorted(entry["shards"], key=lambda s: int(s["idx"]))
+    with ThreadPoolExecutor(
+        max_workers=min(max_workers, max(1, len(shard_specs)))
+    ) as pool:
+        futs = {pool.submit(_fetch, s) for s in shard_specs}
+        deadline = time.monotonic() + timeout
+        ok = 0
+        while futs:
+            done, futs = wait(
+                futs, timeout=max(0.0, deadline - time.monotonic()),
+                return_when=FIRST_COMPLETED,
+            )
+            if not done:
+                break
+            for f in done:
+                idx, body, verdict = f.result()
+                if verdict == "ok":
+                    slots[idx] = body
+                    ok += 1
+                    stats["shards_ok"] += 1
+                else:
+                    stats["shards_corrupt" if verdict == "corrupt"
+                          else "shards_failed"] += 1
+                    _emit(
+                        "shard_corrupt" if verdict == "corrupt"
+                        else "shard_fetch_failed",
+                        {"owner": owner, "step": step, "idx": idx},
+                    )
+            # decode-on-arrival: the moment any k shards verify we can
+            # decode — but data-shard completeness makes it a concat, so
+            # give in-flight data shards until all futures resolve unless
+            # we already have them
+            if ok >= k and all(
+                slots[i] is not None for i in range(k)
+            ):
+                for f in futs:
+                    f.cancel()
+                futs = set()
+    if all(slots[i] is not None for i in range(k)):
+        # every data shard landed in place — blob is the payload (plus
+        # <k padding bytes unpack ignores); no decode pass at all
+        payload: Any = blob_mv[:data_len]
+    else:
+        payload = decode_shards(slots, k, m, data_len)
+    state = unpack_state_blob(payload)
+    stats["reconstruct_s"] = time.monotonic() - t0
+    stats["mb_per_s"] = (
+        data_len / (1024 * 1024) / max(stats["reconstruct_s"], 1e-9)
+    )
+    _emit("reconstruct_done", dict(stats))
+    return step, state, stats
+
+
+# --------------------------------------------------------------------------
+# HotSpare — shadows the fleet, promotes into the next quorum
+# --------------------------------------------------------------------------
+class HotSpare:
+    """A warm replacement replica: registers with the directory as a
+    spare, prefetches every announced shard generation (reconstructing
+    into resident host state as they land), and optionally replays the
+    serving-plane delta chain between generations so its copy tracks the
+    fleet at snapshot cadence. When the directory promotes it (a member
+    died), :meth:`wait_promoted` returns the freshest resident state and
+    the promotion record — the caller loads it and joins the next quorum
+    (``Manager(spare=True).promote()`` does exactly this)."""
+
+    def __init__(
+        self,
+        cfg: RedundancyConfig,
+        spare_id: str,
+        poll_s: float = 0.1,
+        serve_registry: Optional[str] = None,
+        on_metric: Optional[Callable[[str, float], None]] = None,
+    ) -> None:
+        if not cfg.directory:
+            raise ValueError("HotSpare requires a directory URL")
+        self.cfg = cfg
+        self.spare_id = spare_id
+        self.pod = cfg.pod or pod_identity()
+        self._poll_s = poll_s
+        self._on_metric = on_metric or (lambda name, value: None)
+        self._client = DirectoryClient(cfg.directory, timeout=cfg.timeout_s)
+        self._lock = threading.Lock()
+        self._state: Optional[Any] = None
+        self._state_step = -1
+        self._promotion: Optional[Dict[str, Any]] = None
+        self._promoted = threading.Event()
+        self._stop = threading.Event()
+        self._serve_worker = None
+        if serve_registry:
+            # shadow the serving plane too: the delta chain advances the
+            # spare's flat params between shard generations at snapshot
+            # cadence (bitwise by the serving plane's error-feedback
+            # replay), giving promotion a freshness cross-check
+            try:
+                from .serving import ServeWorker
+
+                self._serve_worker = ServeWorker(
+                    serve_registry, name=f"spare-{spare_id}"
+                )
+            except Exception:  # noqa: BLE001 — the spare works without it
+                logger.exception(
+                    "hot spare %s could not attach serve worker", spare_id
+                )
+        self._client.register(
+            self.spare_id, self.pod, store_url="", spare=True
+        )
+        self._thread = threading.Thread(
+            target=self._shadow_loop, daemon=True,
+            name=f"torchft_hot_spare_{spare_id}",
+        )
+        self._thread.start()
+
+    def _shadow_loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                st = self._client.spare_status(self.spare_id)
+                if st.get("promote"):
+                    with self._lock:
+                        self._promotion = st.get("promotion") or {}
+                    self._promoted.set()
+                    return
+                self._prefetch_once()
+            except Exception:  # noqa: BLE001 — keep shadowing
+                logger.debug("hot spare shadow tick failed", exc_info=True)
+
+    def _prefetch_once(self) -> None:
+        d = self._client.get_directory()
+        latest = d.get("latest")
+        if latest is None:
+            return
+        owner, step = str(latest[0]), int(latest[1])
+        with self._lock:
+            if step <= self._state_step:
+                return
+        t0 = time.monotonic()
+        got_step, state, stats = reconstruct_state(
+            self.cfg.directory, owner=owner, timeout=self.cfg.timeout_s
+        )
+        with self._lock:
+            if got_step > self._state_step:
+                self._state = state
+                self._state_step = got_step
+        self._on_metric("spare_prefetch_s", time.monotonic() - t0)
+        self._on_metric("spare_prefetch_steps", 1)
+
+    # -- public api --------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            serve_step = None
+            if self._serve_worker is not None:
+                try:
+                    serve_step = self._serve_worker.status().get("version")
+                except Exception:  # noqa: BLE001
+                    serve_step = None
+            return {
+                "spare_id": self.spare_id,
+                "pod": self.pod,
+                "prefetched_step": self._state_step,
+                "promoted": self._promoted.is_set(),
+                "promotion": dict(self._promotion or {}) or None,
+                "serve_version": serve_step,
+            }
+
+    def prefetched_step(self) -> int:
+        with self._lock:
+            return self._state_step
+
+    def wait_prefetched(self, step: int, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.prefetched_step() >= step:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def wait_promoted(
+        self, timeout: Optional[float] = None
+    ) -> Optional[Tuple[int, Any, Dict[str, Any]]]:
+        """Block until the directory promotes this spare; returns
+        ``(state_step, state, promotion_record)`` or None on timeout."""
+        if not self._promoted.wait(timeout):
+            return None
+        with self._lock:
+            return self._state_step, self._state, dict(self._promotion or {})
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._serve_worker is not None:
+            try:
+                self._serve_worker.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# --------------------------------------------------------------------------
+# CLI — `python -m torchft_tpu.redundancy --hot-spare ...`
+# --------------------------------------------------------------------------
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="torchft_tpu redundancy plane (docs/operations.md)"
+    )
+    parser.add_argument(
+        "--hot-spare", action="store_true",
+        help="run a hot-spare shadow: prefetch shard generations and "
+        "exit 0 printing the promotion record when promoted",
+    )
+    parser.add_argument(
+        "--directory", default=None,
+        help=f"ShardDirectory URL (default ${REDUNDANCY_DIRECTORY_ENV})",
+    )
+    parser.add_argument(
+        "--spare-id", default=f"spare_{os.getpid()}",
+        help="replica id to register the spare under",
+    )
+    parser.add_argument(
+        "--serve-registry", default=None,
+        help="optional serving-plane registry URL to shadow the delta "
+        "chain between shard generations",
+    )
+    parser.add_argument(
+        "--status-interval", type=float, default=2.0,
+        help="seconds between status lines",
+    )
+    args = parser.parse_args(argv)
+    if not args.hot_spare:
+        parser.error("only --hot-spare mode is defined for this entrypoint")
+    cfg = RedundancyConfig.from_env(directory=args.directory)
+    if not cfg.directory:
+        parser.error(
+            f"--directory or ${REDUNDANCY_DIRECTORY_ENV} is required"
+        )
+    logging.basicConfig(level=logging.INFO)
+    spare = HotSpare(
+        cfg, args.spare_id, serve_registry=args.serve_registry
+    )
+    try:
+        while True:
+            result = spare.wait_promoted(timeout=args.status_interval)
+            if result is not None:
+                step, _state, promo = result
+                print(json.dumps(
+                    {"promoted": True, "state_step": step, **promo}
+                ))
+                return 0
+            print(json.dumps(spare.status()))
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        spare.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -58,11 +58,19 @@ def test_random_kills_converge_bitwise(transport_kind):
     """Parametrized over the healing transport: "pg" puts the per-quorum
     transport-configure hook and the dedicated recovery PG's rendezvous
     under the same randomized kill schedule as the main protocol."""
+    from torchft_tpu.analysis import lockgraph
+
     rng = random.Random(0xC0FFEE)
-    _run_soak_phase(
-        rng, "host", transport_kind, "dynamic", N_REPLICAS, CHAOS_SECONDS,
-        target=TARGET_STEPS,
-    )
+    # the whole soak runs under the lock-order race detector: every lock
+    # the managers/PGs/clients create during the chaos schedule joins the
+    # acquisition-order graph, and any A→B / B→A inversion fails the test
+    # even if this particular schedule never deadlocked
+    with lockgraph.watch() as graph:
+        _run_soak_phase(
+            rng, "host", transport_kind, "dynamic", N_REPLICAS,
+            CHAOS_SECONDS, target=TARGET_STEPS,
+        )
+    lockgraph.assert_clean(graph)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,437 @@
+"""fleetlint analyzer tests: each checker pinned on seeded fixture
+snippets (positive AND negative), the zero-new-findings gate over the
+real package, and the runtime lock-order detector's cycle catch.
+
+The fixture snippets are written to a temp package and analyzed through
+the same ``load_repo``/``check`` path production uses — these tests are
+what guarantees ``python -m torchft_tpu.analysis --ci`` would actually
+catch each violation class if someone introduced it.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from torchft_tpu.analysis import core, lockgraph
+from torchft_tpu.analysis import (
+    blocking_calls,
+    counter_contract,
+    env_contract,
+    lock_discipline,
+    stale_guard,
+)
+
+
+def _repo(tmp_path: Path, files: dict, docs: dict | None = None) -> core.Repo:
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    for name, text in files.items():
+        (pkg / name).write_text(textwrap.dedent(text))
+    docs_dir = tmp_path / "docs"
+    docs_dir.mkdir(exist_ok=True)
+    for name, text in (docs or {}).items():
+        (docs_dir / name).write_text(textwrap.dedent(text))
+    return core.load_repo(pkg, docs_dir)
+
+
+def _rules(findings) -> set:
+    return {(f.rule, f.key) for f in findings}
+
+
+# ---------------------------------------------------------------- env-contract
+class TestEnvContract:
+    def test_unregistered_read_flagged_registered_not(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "mod.py": """
+                import os
+                a = os.environ.get("TORCHFT_NOT_A_KNOB")
+                b = os.environ.get("TORCHFT_LIGHTHOUSE")  # registered
+                """
+            },
+        )
+        rules = _rules(env_contract.check(repo))
+        assert ("unregistered-read", "TORCHFT_NOT_A_KNOB") in rules
+        assert ("unregistered-read", "TORCHFT_LIGHTHOUSE") not in rules
+
+    def test_constant_and_helper_indirection_resolve(self, tmp_path):
+        """The repo's two real idioms: module *_ENV constants, and the
+        from_env ``_pick(env, ...)`` helper-parameter pattern."""
+        repo = _repo(
+            tmp_path,
+            {
+                "mod.py": """
+                import os
+                SEEDED_ENV = "TORCHFT_SEEDED_KNOB"
+
+                def _pick(env, cast):
+                    return cast(os.environ.get(env, "0"))
+
+                def from_env():
+                    direct = os.environ.get(SEEDED_ENV)
+                    via_helper = _pick("TORCHFT_HELPER_KNOB", int)
+                    return direct, via_helper
+                """
+            },
+        )
+        keys = {name for _, _, name in env_contract.collect_env_reads(repo)}
+        assert "TORCHFT_SEEDED_KNOB" in keys
+        assert "TORCHFT_HELPER_KNOB" in keys
+
+    def test_real_package_env_reads_all_registered(self):
+        """Every TORCHFT_* read in the shipped package resolves to a
+        registry entry — the contract the doctor check re-validates."""
+        from torchft_tpu import knobs
+
+        repo = core.load_repo()
+        unregistered = {
+            name
+            for _, _, name in env_contract.collect_env_reads(repo)
+            if not knobs.is_registered(name)
+        }
+        assert unregistered == set()
+
+
+# ------------------------------------------------------------ counter-contract
+class TestCounterContract:
+    def test_undeclared_emission_flagged(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "manager.py": """
+                class M:
+                    def step(self):
+                        self._record_timing("totally_new_key_s", 1.0)
+                        self._bump_counter("heal_attempts")  # declared
+                """
+            },
+            docs={"observability.md": "heal_attempts lives here"},
+        )
+        rules = _rules(counter_contract.check(repo))
+        assert ("undeclared-counter", "totally_new_key_s") in rules
+        assert ("undeclared-counter", "heal_attempts") not in rules
+
+    def test_counter_map_values_and_seed_loops_extracted(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "manager.py": """
+                class M:
+                    def on_event(self, kind):
+                        key = {"heal_retry": "map_value_key"}.get(kind)
+                        if key:
+                            self._bump_counter(key)
+
+                    def seed(self):
+                        for k in ("seeded_a", "seeded_b"):
+                            self._timings[k] = 0.0
+                """
+            },
+        )
+        keys = {
+            k
+            for src in repo.sources
+            for k, _ in counter_contract.extract_emitted(src)
+        }
+        assert {"map_value_key", "seeded_a", "seeded_b"} <= keys
+
+    def test_dead_declaration_flagged(self, tmp_path):
+        """A declared key with no emission left in the scoped modules is
+        drift in the docs->code direction."""
+        repo = _repo(
+            tmp_path,
+            {"manager.py": "class M:\n    pass\n"},
+            docs={"observability.md": "all keys documented"},
+        )
+        rules = {f.rule for f in counter_contract.check(repo)}
+        assert "dead-declaration" in rules  # nothing is emitted here
+
+    def test_real_package_has_no_undeclared_emissions(self):
+        repo = core.load_repo()
+        bad = [
+            f
+            for f in counter_contract.check(repo)
+            if f.rule in ("undeclared-counter", "undeclared-series")
+        ]
+        assert bad == [], [f.render() for f in bad]
+
+
+# ------------------------------------------------------------- lock-discipline
+_RACY = """
+import threading
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {"errs": 0}
+        self._t = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self.counters["errs"] += 1  # written on the thread, no lock
+
+    def read(self):
+        return dict(self.counters)  # read from callers, no lock
+"""
+
+
+class TestLockDiscipline:
+    def test_unguarded_cross_thread_attr_flagged(self, tmp_path):
+        repo = _repo(tmp_path, {"mod.py": _RACY})
+        rules = _rules(lock_discipline.check(repo))
+        assert ("unguarded-shared-attr", "Racy.counters") in rules
+
+    def test_guarded_version_passes(self, tmp_path):
+        guarded = _RACY.replace(
+            'self.counters["errs"] += 1  # written on the thread, no lock',
+            'with self._lock:\n            self.counters["errs"] += 1',
+        ).replace(
+            "return dict(self.counters)  # read from callers, no lock",
+            "with self._lock:\n            return dict(self.counters)",
+        )
+        repo = _repo(tmp_path, {"mod.py": guarded})
+        assert lock_discipline.check(repo) == []
+
+    def test_atomic_attrs_allowlist_suppresses(self, tmp_path):
+        allowed = _RACY.replace(
+            "class Racy:",
+            'class Racy:\n    _atomic_attrs = ("counters",)',
+        )
+        repo = _repo(tmp_path, {"mod.py": allowed})
+        assert lock_discipline.check(repo) == []
+
+    def test_locked_suffix_convention_trusted(self, tmp_path):
+        """Methods named *_locked are callee-documented as lock-held."""
+        conv = _RACY.replace(
+            "def read(self):", "def read_locked(self):"
+        ).replace(
+            'self.counters["errs"] += 1  # written on the thread, no lock',
+            'with self._lock:\n            self.counters["errs"] += 1',
+        )
+        repo = _repo(tmp_path, {"mod.py": conv})
+        assert lock_discipline.check(repo) == []
+
+    def test_real_package_is_clean(self):
+        repo = core.load_repo()
+        findings = lock_discipline.check(repo)
+        assert findings == [], [f.render() for f in findings]
+
+
+# -------------------------------------------------------------- blocking-calls
+class TestBlockingCalls:
+    def test_bare_urlopen_in_hot_module_flagged(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "manager.py": """
+                import urllib.request
+
+                def fetch(url):
+                    return urllib.request.urlopen(url).read()
+                """
+            },
+        )
+        assert {f.rule for f in blocking_calls.check(repo)} == {
+            "missing-timeout"
+        }
+
+    def test_timeout_and_retry_call_exempt(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "manager.py": """
+                import urllib.request
+                from .retry import retry_call
+
+                def good(url, policy):
+                    a = urllib.request.urlopen(url, timeout=5.0).read()
+                    b = retry_call(
+                        lambda: urllib.request.urlopen(url).read(), policy
+                    )
+                    return a, b
+                """
+            },
+        )
+        assert blocking_calls.check(repo) == []
+
+    def test_cold_modules_out_of_scope(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "launcher.py": """
+                import urllib.request
+
+                def fetch(url):
+                    return urllib.request.urlopen(url).read()
+                """
+            },
+        )
+        assert blocking_calls.check(repo) == []
+
+    def test_real_package_hot_paths_bounded(self):
+        repo = core.load_repo()
+        findings = blocking_calls.check(repo)
+        assert findings == [], [f.render() for f in findings]
+
+
+# ----------------------------------------------------------------- stale-guard
+class TestStaleGuard:
+    def test_unguarded_epoch_seq_consumer_flagged(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "mod.py": """
+                def handle(self, msg):
+                    self.epoch = msg["epoch"]
+                    self.seq = msg["seq"]
+                    self.apply(msg)
+                """
+            },
+        )
+        rules = _rules(stale_guard.check(repo))
+        assert ("missing-stale-guard", "handle") in rules
+
+    def test_monotonic_compare_passes(self, tmp_path):
+        repo = _repo(
+            tmp_path,
+            {
+                "mod.py": """
+                def handle(self, msg):
+                    epoch, seq = msg["epoch"], msg["seq"]
+                    if (epoch, seq) <= (self.epoch, self.seq):
+                        return "stale"
+                    self.apply(msg)
+                """
+            },
+        )
+        assert stale_guard.check(repo) == []
+
+    def test_real_package_handlers_guarded(self):
+        repo = core.load_repo()
+        findings = stale_guard.check(repo)
+        assert findings == [], [f.render() for f in findings]
+
+
+# ------------------------------------------------- baseline + whole-repo gate
+class TestRepoGate:
+    def test_zero_findings_beyond_committed_baseline(self):
+        """The tier-1 mirror of `python -m torchft_tpu.analysis --ci`:
+        the shipped package plus docs carry no finding the committed
+        baseline does not justify, and no baseline entry is stale."""
+        findings = core.run_all()
+        baseline = core.load_baseline()
+        new, stale = core.diff_baseline(findings, baseline)
+        assert new == [], [f.render() for f in new]
+        assert stale == []
+
+    def test_baseline_entries_all_justified(self):
+        for fp, why in core.load_baseline().items():
+            assert why.strip(), f"baseline entry {fp} has no justification"
+
+    def test_fingerprint_is_line_stable(self):
+        a = core.Finding("c", "r", "p.py", 10, "k", "m")
+        b = core.Finding("c", "r", "p.py", 99, "k", "m")
+        assert a.fingerprint == b.fingerprint
+
+    def test_doctor_fleetlint_check_passes(self):
+        from torchft_tpu.doctor import check_fleetlint
+
+        status, detail = check_fleetlint()
+        assert status is not False, detail
+
+
+# ------------------------------------------------------------------- lockgraph
+class TestLockGraph:
+    def test_ab_ba_inversion_detected(self):
+        """The classic deadlock shape: thread 1 takes A then B, thread 2
+        takes B then A. Neither execution deadlocks (they run serially),
+        but the acquisition-order graph has the A→B / B→A cycle."""
+        with lockgraph.watch() as graph:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def t1():
+                with a:
+                    with b:
+                        pass
+
+            def t2():
+                with b:
+                    with a:
+                        pass
+
+            for fn in (t1, t2):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+        cycles = graph.cycles()
+        assert len(cycles) == 1 and len(cycles[0]) == 2
+        with pytest.raises(AssertionError, match="lock-order cycles"):
+            lockgraph.assert_clean(graph)
+
+    def test_consistent_order_is_clean(self):
+        with lockgraph.watch() as graph:
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        assert graph.cycles() == []
+        lockgraph.assert_clean(graph)
+
+    def test_rlock_reentry_is_not_a_self_edge(self):
+        with lockgraph.watch() as graph:
+            r = threading.RLock()
+
+            def recurse(n):
+                with r:
+                    if n:
+                        recurse(n - 1)
+
+            recurse(3)
+        assert graph.cycles() == []
+
+    def test_condition_wait_keeps_bookkeeping(self):
+        """threading.Condition bypasses release() via the private
+        _release_save protocol — the instrumented lock must keep the
+        held-stack honest through a wait/notify cycle."""
+        with lockgraph.watch() as graph:
+            cond = threading.Condition()
+            ready = []
+
+            def waiter():
+                with cond:
+                    while not ready:
+                        cond.wait(timeout=5.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                ready.append(1)
+                cond.notify()
+            t.join(5.0)
+            assert not t.is_alive()
+        assert graph.cycles() == []
+
+    def test_hold_time_tracked(self):
+        with lockgraph.watch(hold_warn_ms=1.0) as graph:
+            lk = threading.Lock()
+            with lk:
+                time.sleep(0.02)
+        assert graph.hold_violations()  # 20ms > 1ms threshold
+        lockgraph.assert_clean(graph)  # holds don't fail by default
+        with pytest.raises(AssertionError, match="held >"):
+            lockgraph.assert_clean(graph, max_hold_ms=1.0)
+
+    def test_nested_watch_refused(self):
+        with lockgraph.watch():
+            with pytest.raises(RuntimeError, match="already active"):
+                with lockgraph.watch():
+                    pass

@@ -63,7 +63,20 @@ def _announce_body(
 
 
 @pytest.fixture()
-def directory():
+def lockwatch():
+    """Runtime lock-order race detector under every directory-backed
+    test: locks created while the plane runs are instrumented, and any
+    A→B / B→A acquisition inversion fails the test even if the deadlock
+    schedule never fires (torchft_tpu/analysis/lockgraph.py)."""
+    from torchft_tpu.analysis import lockgraph
+
+    with lockgraph.watch() as g:
+        yield g
+    lockgraph.assert_clean(g)
+
+
+@pytest.fixture()
+def directory(lockwatch):
     # long dead_after_s: the announce-gap detector must not interfere
     # with protocol tests that hold generations at different steps
     d = ShardDirectory(poll_s=0.05, dead_after_s=60.0)
@@ -409,7 +422,11 @@ class TestReconstruct:
             directory.url, owner="own", timeout=10.0, max_workers=3
         )
         assert step == 5
-        assert stats["shards_ok"] == self.K + self.M
+        # decode-on-arrival cancels the parity fetch once all K data
+        # shards land, so shards_ok is K..K+M depending on timing
+        assert self.K <= stats["shards_ok"] <= self.K + self.M
+        assert stats["shards_failed"] == 0
+        assert stats["shards_corrupt"] == 0
         np.testing.assert_array_equal(np.asarray(got["w"]), state["w"])
 
     def test_dead_data_holder_fails_over_to_parity(self, directory, stores):

@@ -538,23 +538,30 @@ class TestWorkerFailover:
 
     def test_infer_never_fails_during_source_loss(self):
         """The request plane answers from the last applied snapshot under
-        a local lock — killing every source must not fail /infer."""
-        reg, cfg, pubs, _ = self._fleet()
-        w = ServeWorker(reg.url, config=cfg, name="w", start=False)
-        try:
-            assert w.pull_once()
-            before = w.answer(seed=42)
-            for p in pubs:
-                p.kill()
-            assert w.pull_once() is False  # nothing new reachable
-            after = w.answer(seed=42)
-            assert before["result"] == after["result"]
-            assert after["version"] == [1, 1]
-        finally:
-            w.shutdown()
-            for p in pubs:
-                p.shutdown()
-            reg.shutdown()
+        a local lock — killing every source must not fail /infer. The
+        whole fleet runs under the lock-order race detector: a registry/
+        publisher/worker acquisition inversion fails here even when the
+        deadlock schedule never fires."""
+        from torchft_tpu.analysis import lockgraph
+
+        with lockgraph.watch() as graph:
+            reg, cfg, pubs, _ = self._fleet()
+            w = ServeWorker(reg.url, config=cfg, name="w", start=False)
+            try:
+                assert w.pull_once()
+                before = w.answer(seed=42)
+                for p in pubs:
+                    p.kill()
+                assert w.pull_once() is False  # nothing new reachable
+                after = w.answer(seed=42)
+                assert before["result"] == after["result"]
+                assert after["version"] == [1, 1]
+            finally:
+                w.shutdown()
+                for p in pubs:
+                    p.shutdown()
+                reg.shutdown()
+        lockgraph.assert_clean(graph)
 
 
 # ------------------------------------------------------- publisher lifecycle

@@ -33,6 +33,7 @@ Exit code 0 iff every check passes (the accelerator check passes as
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -737,6 +738,82 @@ def check_redundancy_roundtrip() -> Result:
         directory.shutdown()
 
 
+def check_tuning_env() -> Result:
+    """Registry-driven sanity for every tuning knob that has no
+    plane-specific doctor check: each ``TORCHFT_*`` value set in the
+    environment must parse per its declared type in the knob registry
+    (torchft_tpu/knobs.py), JSON knobs must decode to objects, and enums
+    must name a declared member. Catches the classic fleet-rollout typo
+    (``TORCHFT_BUCKET_CAP_MB=32mb``) before it silently falls back."""
+    from torchft_tpu import knobs
+
+    checked = 0
+    problems: List[str] = []
+    for name, knob in sorted(knobs.all_knobs().items()):
+        if knob.doctor != "tuning-env":
+            continue
+        raw = os.environ.get(name)
+        checked += 1
+        if raw is None or raw.strip() == "":
+            continue
+        try:
+            if knob.type == "int":
+                int(raw)
+            elif knob.type == "float":
+                float(raw)
+            elif knob.type == "bool":
+                if raw.strip().lower() not in (
+                    "0", "1", "true", "false", "yes", "no", "on", "off"
+                ):
+                    raise ValueError(f"not a boolean: {raw!r}")
+            elif knob.type.startswith("enum("):
+                members = knob.type[5:-1].split("|")
+                if raw not in members:
+                    raise ValueError(f"{raw!r} not in {members}")
+            elif name.endswith("_JSON"):
+                if not isinstance(json.loads(raw), dict):
+                    raise ValueError("must decode to a JSON object")
+        except ValueError as e:
+            problems.append(f"{name}={raw!r} ({e})")
+    if problems:
+        return False, "; ".join(problems)
+    n_set = sum(
+        1
+        for name, knob in knobs.all_knobs().items()
+        if knob.doctor == "tuning-env" and os.environ.get(name)
+    )
+    return True, f"{checked} tuning knob(s) registered, {n_set} set, all parse"
+
+
+def check_fleetlint() -> Result:
+    """In-process fleetlint env-contract run: every TORCHFT_* read in the
+    package is registered/documented/doctored, and no finding beyond the
+    committed baseline (torchft_tpu/analysis/baseline.json). The full
+    five-checker run lives in CI (`python -m torchft_tpu.analysis --ci`);
+    the env contract is the part that drifts with operator-facing
+    surface, so the doctor re-validates it on any host."""
+    from torchft_tpu.analysis import core
+
+    findings = core.run_all(checkers=["env-contract"])
+    baseline = core.load_baseline()
+    new, stale = core.diff_baseline(findings, baseline)
+    if new:
+        head = "; ".join(f"{f.rule}:{f.key}" for f in new[:5])
+        more = f" (+{len(new) - 5} more)" if len(new) > 5 else ""
+        return False, (
+            f"{len(new)} env-contract finding(s) beyond baseline: "
+            f"{head}{more} — run python -m torchft_tpu.analysis"
+        )
+    detail = (
+        f"{len(findings)} finding(s), all baselined"
+        if findings
+        else "env contract clean"
+    )
+    if stale:
+        return None, f"{detail}; {len(stale)} stale baseline entr(y/ies)"
+    return True, detail
+
+
 CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("native", check_native),
     ("accelerator", check_accelerator),
@@ -749,6 +826,8 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("serve-env", check_serve_env),
     ("redundancy-env", check_redundancy_env),
     ("trace-env", check_trace_env),
+    ("tuning-env", check_tuning_env),
+    ("fleetlint", check_fleetlint),
     ("health-http", check_health_endpoint),
     ("metrics-http", check_metrics_endpoints),
     ("heal", check_heal_roundtrip),

@@ -93,7 +93,9 @@ class HealthConfig:
         defaults = HealthConfig()
 
         def _get(name: str, cast: Any, default: Any) -> Any:
-            raw = os.environ.get(name)
+            from torchft_tpu import knobs
+
+            raw = knobs.env_raw(name)  # KeyError on unregistered names
             if raw is None or raw == "":
                 return default
             try:

@@ -443,9 +443,9 @@ class DiLoCo:
         # (local_sgd.py:225-228): the env var force-enables bucketization
         # even when the constructor passed use_bucketization=False; it never
         # force-disables.
-        env_bucketization = os.environ.get(
-            "TORCHFT_USE_BUCKETIZATION", "false"
-        ).lower() in ("1", "true", "yes")
+        from torchft_tpu import knobs
+
+        env_bucketization = knobs.env_bool("TORCHFT_USE_BUCKETIZATION")
         use_bucketization = env_bucketization or bool(use_bucketization)
         bucket_cap_bytes = (
             bucket_cap_mb * 1024 * 1024

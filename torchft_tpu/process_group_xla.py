@@ -459,7 +459,8 @@ class ProcessGroupXLA(ProcessGroup):
         cannot mix with new-world arrays inside one jitted computation —
         the Manager watches this and re-lands registered user state on the
         live backend at the next main-thread sync point."""
-        return self._device_world_epoch
+        with self._lock:
+            return self._device_world_epoch
 
     def _distributed_work(self, fn: Callable[[], Any]) -> Work:
         """Distributed-mode op: dispatch + materialization on one worker
@@ -667,7 +668,8 @@ class ProcessGroupXLA(ProcessGroup):
                 jax.extend.backend.clear_backends()
             except Exception as e:  # noqa: BLE001
                 logger.warning("clear_backends failed: %s", e)
-            self._device_world_epoch += 1
+            with self._lock:
+                self._device_world_epoch += 1
             devices = jax.devices()
         leads = []
         for p in range(world_size):
@@ -712,7 +714,11 @@ class ProcessGroupXLA(ProcessGroup):
             jax.extend.backend.clear_backends()
         except Exception as e:  # noqa: BLE001
             logger.warning("clear_backends failed: %s", e)
-        self._device_world_epoch += 1
+        # the abort watchdog runs this teardown on a daemon thread while
+        # the main thread may be reading device_world_epoch — a bare += 1
+        # here can lose a bump and mask a backend rebuild from the Manager
+        with self._lock:
+            self._device_world_epoch += 1
 
         state = _dist.global_state
         client, state.client = state.client, None

@@ -506,7 +506,7 @@ class SnapshotRegistry:
                 self._drained_manual.discard(replica_id)
             if before != drain:
                 self._counters["drain_transitions_total"] += 1
-            return 200, {"ok": True, "draining": sorted(self._all_drained())}
+            return 200, {"ok": True, "draining": sorted(self._all_drained_locked())}
 
     def forget(self, replica_id: str) -> None:
         with self._lock:
@@ -518,7 +518,7 @@ class SnapshotRegistry:
         version wins ties), drained sources kept at the TAIL — a fully
         drained fleet still serves rather than failing requests."""
         with self._lock:
-            drained = self._all_drained()
+            drained = self._all_drained_locked()
             entries = []
             for rid, src in self._sources.items():
                 entries.append(
@@ -573,12 +573,12 @@ class SnapshotRegistry:
             pass
 
     # -- internals ---------------------------------------------------------
-    def _all_drained(self) -> set:
+    def _all_drained_locked(self) -> set:
         return set(self._drained_health) | self._drained_manual
 
     def _latest_locked(self) -> Optional[List[int]]:
         best: Optional[List[int]] = None
-        drained = self._all_drained()
+        drained = self._all_drained_locked()
         pool = [
             src["version"]
             for rid, src in self._sources.items()
@@ -635,7 +635,7 @@ class SnapshotRegistry:
 
     def _refresh_metrics(self) -> None:
         with self._lock:
-            drained = self._all_drained()
+            drained = self._all_drained_locked()
             latest = self._latest_locked()
             n_sources = len(self._sources)
             counters = dict(self._counters)
@@ -1323,7 +1323,8 @@ class ServeWorker:
             try:
                 self.pull_once()
             except Exception:  # noqa: BLE001 — keep answering regardless
-                self.counters["pull_errors_total"] += 1
+                with self._lock:
+                    self.counters["pull_errors_total"] += 1
                 logger.debug("worker pull failed", exc_info=True)
 
     def pull_once(self) -> bool:
@@ -1360,7 +1361,8 @@ class ServeWorker:
     def _full_pull(self, sources: List[Dict[str, Any]], latest_v: Version) -> bool:
         def on_event(kind: str, **fields: Any) -> None:
             if kind == "heal_failover":
-                self.counters["pull_failovers_total"] += 1
+                with self._lock:
+                    self.counters["pull_failovers_total"] += 1
 
         flat, meta = pull_full_snapshot(
             sources, latest_v, timeout=self.cfg.timeout_s, on_event=on_event
@@ -1455,7 +1457,8 @@ class ServeWorker:
                     return nxt
             except Exception as e:  # noqa: BLE001 — next source
                 last_exc = e
-                self.counters["pull_failovers_total"] += 1
+                with self._lock:
+                    self.counters["pull_failovers_total"] += 1
                 continue
         if last_exc is not None:
             logger.debug("delta fetch exhausted sources: %r", last_exc)

@@ -861,6 +861,87 @@ def recovery(smoke: bool = False) -> None:
     }))
 
 
+def degrade_metrics(smoke: bool = False) -> dict:
+    """Run benchmarks/degrade_bench.py in a subprocess (it stands up two
+    managed fleets, a lighthouse, and loopback shard/checkpoint HTTP —
+    own process keeps fd/thread blast radius away from the bench
+    harness) and parse its one-line JSON summary."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "degrade_bench.py",
+    )
+    cmd = [sys.executable, script] + (["--smoke"] if smoke else [])
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True,
+        timeout=600 if smoke else 3600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"degrade bench failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip().splitlines()[-8:]}"
+        )
+    last = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")][-1]
+    return _json.loads(last)
+
+
+def degrade(smoke: bool = False) -> None:
+    """``python bench.py --degrade [--smoke]``: one JSON line with the
+    degrade-plane summary. The gates hold the plane's promises
+    (docs/operations.md "Degraded replicas"): the in-place reshard
+    latency (``degraded_reshard_s`` — the cost the degrade adds to the
+    one re-planned slow step, during which the replica never leaves the
+    loop) is a real factor faster than the classic leave-heal-rejoin
+    cycle's rejoin wall (>= 3x at the largest state — the in-place path
+    moves state/k bytes where the classic path restarts the process and
+    moves all of them), the quorum never shrinks through the degrade,
+    and the shrunken layout is bitwise-equal to the full one. Full runs
+    also write BENCH_DEGRADE.json."""
+    metrics = degrade_metrics(smoke=smoke)
+    required = [
+        "degrade_speedup_x",
+        "degrade_in_place_s_at_max",
+        "degrade_classic_rejoin_s_at_max",
+        "degrade_quorum_never_shrank",
+        "degrade_bitwise_ok",
+    ]
+    missing = [k for k in required if metrics.get(k) is None]
+    if missing:
+        raise RuntimeError(f"degrade: missing keys: {missing}")
+    if not metrics["degrade_quorum_never_shrank"]:
+        raise RuntimeError(
+            "degrade: the quorum shrank during an in-place degrade — the "
+            "replica left instead of resharding"
+        )
+    if not metrics["degrade_bitwise_ok"]:
+        raise RuntimeError(
+            "degrade: the shrunken layout is not bitwise-equal to the "
+            "full one"
+        )
+    # Smoke states (8 MB) barely cover the classic path's fixed costs
+    # (restart + quorum rejoin dominate the heal), so the gate is lower.
+    min_speedup = 1.5 if smoke else 3.0
+    if not metrics["degrade_speedup_x"] >= min_speedup:
+        raise RuntimeError(
+            f"degrade: in-place reshard only "
+            f"{metrics['degrade_speedup_x']:.2f}x faster than "
+            f"leave-heal-rejoin (gate: {min_speedup}x) — the gather-free "
+            "shard-sourced path regressed"
+        )
+    print(json.dumps({
+        "metric": "in-place degrade speedup over leave-heal-rejoin",
+        "value": metrics["degrade_speedup_x"],
+        "unit": "x",
+        "vs_baseline": metrics["degrade_speedup_x"],
+        **metrics,
+    }))
+
+
 def main() -> None:
     # shared fallback policy (ensure_responsive_backend): one probe, one
     # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
@@ -1149,6 +1230,10 @@ if __name__ == "__main__":
     if "--recovery" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         recovery(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--degrade" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        degrade(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--smoke" in sys.argv[1:]:
         # no always-emit wrapper here: the smoke gate must fail loudly

@@ -1,0 +1,334 @@
+"""Degrade-plane benchmark: in-place TP shrink after an intra-group chip
+death vs the classic leave-heal-rejoin cycle. Prints ONE JSON line; full
+runs also write ``BENCH_DEGRADE.json``.
+
+    python benchmarks/degrade_bench.py [--smoke]
+
+Both legs run REAL managed fleets on this host (lighthouse + Managers +
+the host data plane over loopback HTTP/TCP) at the same state size, so
+the ratio compares like with like:
+
+- **classic**: recovery_bench's kill scenario — one of two replicas dies
+  mid-run, restarts, and heals the FULL state from the surviving peer
+  over the HTTP checkpoint transport. The comparator is ``rejoin_s``
+  (dead replica's Manager construction -> first commit: quorum rejoin +
+  full-state heal), i.e. how long the replica is out of the training
+  loop.
+- **in_place**: a two-replica fleet where replica 0 declares a k-chip
+  group degree; one chip is killed mid-run via the fault injector. The
+  manager stages the degrade and commits it at the next safe point: the
+  registered reshard hook fetches ONLY the dead chip's shard (state/k
+  bytes) over a real loopback ShardStore GET — the gather-free path the
+  erasure/heal transport provides — and remaps the param tree onto k-1
+  chips (parallel/degrade.reshard_from_survivors), asserting the
+  shrunken layout reassembles bitwise-identical. The comparator is the
+  manager's ``degraded_reshard_s`` — the latency the degrade ADDS to the
+  one re-planned slow step (fetch + reshard + verify). The replica never
+  leaves the loop: unlike the classic leg, the step containing the
+  reshard still commits, so the steady step it rides is not downtime and
+  is not double-counted (the raw kill -> degraded-commit wall clock,
+  which does include that step, is recorded as
+  ``in_place_commit_window_s`` for reference). The quorum never shrinks
+  (asserted).
+
+Provenance caveat (read before quoting): the dead chip's shard is staged
+to the loopback store at the kill point (standing in for the redundancy
+plane's per-commit staging, whose steady cost is measured separately by
+``bench.py --recovery``); staging cost is NOT in the timed window, the
+shard fetch over real HTTP IS. Loopback wire for both legs; ratios are
+the claim, absolute seconds are this host's.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+REPO_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+FULL_SIZES_MB = (16, 64, 128)
+SMOKE_SIZES_MB = (8,)
+
+
+def classic_point(size_mb: int, steps: int, kill_at: int) -> dict:
+    """Leave-heal-rejoin at one state size: recovery_bench's real kill +
+    restart + full-state heal scenario on the host plane."""
+    from recovery_bench import run as recovery_run
+
+    r = recovery_run(
+        size_mb=size_mb, steps=steps, kill_at=kill_at, plane="host",
+        transport="http",
+    )
+    return {
+        "size_mb": size_mb,
+        "classic_rejoin_s": r["rejoin_s"],
+        "classic_recovery_s": r["recovery_s"],
+        "classic_heal_recv_s": r.get("heal_recv_s"),
+        "classic_steady_step_s": r["steady_step_s"],
+    }
+
+
+def in_place_point(
+    size_mb: int, steps: int, kill_at: int, degree: int = 4
+) -> dict:
+    """In-place shrink at one state size: kill chip ``degree-1`` of
+    replica 0's group mid-run; the staged degrade commits at the next
+    safe point with the lost shard sourced over a real loopback shard
+    store. Returns the kill->degraded-commit window plus the engine's
+    own reshard stats."""
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.degrade import (
+        assemble,
+        reshard_from_survivors,
+        split_even,
+    )
+    from torchft_tpu.process_group import (
+        FakeProcessGroupWrapper,
+        ProcessGroupHost,
+    )
+    from torchft_tpu.redundancy import ShardStore, get_shard, put_shard
+    from torchft_tpu.checkpointing.erasure import shard_crc
+
+    n_elem = size_mb * (1 << 20) // 4
+    dead_rank = degree - 1
+    axes = {"w": 0}
+
+    env_keys = {"TORCHFT_DEGRADE": "on"}
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=2000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=3000,
+    )
+    store = ShardStore("degrade_bench_peer")
+    result: dict = {}
+    errors: list = []
+    # replica 1 watches the quorum across the kill window: the whole
+    # point of degrading in place is that membership never changes
+    min_participants = [2]
+
+    def replica(rid: int) -> None:
+        params = {"w": np.zeros(n_elem, dtype=np.float32)}
+        pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=30.0))
+        manager = Manager(
+            pg=pg,
+            load_state_dict=lambda sd: params.update(
+                w=np.asarray(sd["w"], dtype=np.float32)
+            ),
+            state_dict=lambda: {"w": params["w"]},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id=f"degrade_bench_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=30.0,
+            quorum_timeout=15.0,
+        )
+        killed_at = [0.0]
+        if rid == 0:
+            manager.set_group_degree(degree)
+
+            def reshard(dead: int, new_degree: int):
+                # survivors' shards are resident slices of the live
+                # params; the dead chip's shard comes off the wire
+                shards = split_even(params["w"], degree, 0)
+                lost_ref = shards[dead]
+                fetched = np.frombuffer(
+                    get_shard(
+                        store.url, "degrade_bench_0", kill_at, dead,
+                        lost_ref.nbytes, shard_crc(lost_ref.tobytes()),
+                        timeout=300.0,
+                    ),
+                    dtype=np.float32,
+                )
+                rank_trees = [
+                    None if r == dead else {"w": shards[r]}
+                    for r in range(degree)
+                ]
+                trees, stats = reshard_from_survivors(
+                    rank_trees, dead, axes,
+                    shard_source=lambda path: fetched,
+                )
+                back = assemble(trees, axes)
+                if not np.array_equal(back["w"], params["w"]):
+                    raise RuntimeError(
+                        "in-place reshard is not bitwise-equal"
+                    )
+                result["reshard_stats"] = stats.to_json()
+                return stats
+
+            manager.set_reshard_fn(reshard)
+        grads = {"w": np.full(n_elem, 0.01, dtype=np.float32)}
+        try:
+            while manager.current_step() < steps:
+                manager.start_quorum()
+                avg = manager.allreduce(grads).get_future().wait(120)
+                if manager.should_commit():
+                    params["w"] = params["w"] - np.asarray(avg["w"])
+                    step = manager.current_step()
+                    if rid == 1:
+                        min_participants[0] = min(
+                            min_participants[0], manager.num_participants()
+                        )
+                    if rid == 0 and step == kill_at:
+                        # stage the chip's shard (the redundancy plane's
+                        # job, costed by bench.py --recovery) then kill it
+                        body = np.ascontiguousarray(
+                            split_even(params["w"], degree, 0)[dead_rank]
+                        ).tobytes()
+                        put_shard(
+                            store.url, "degrade_bench_0", kill_at,
+                            dead_rank, body, timeout=300.0,
+                        )
+                        killed_at[0] = time.perf_counter()
+                        pg.inject_group_member_death(dead_rank)
+                    if (
+                        rid == 0
+                        and killed_at[0]
+                        and "in_place_s" not in result
+                        and manager.timings().get("degrade_events", 0) >= 1
+                    ):
+                        result["in_place_s"] = (
+                            time.perf_counter() - killed_at[0]
+                        )
+                        result["degraded_reshard_s"] = manager.timings()[
+                            "degraded_reshard_s"
+                        ]
+                        result["group_degree_after"] = manager.group_degree
+            if rid == 0:
+                result["degrade_events"] = manager.timings().get(
+                    "degrade_events"
+                )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            manager.shutdown(wait=False)
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [ex.submit(replica, r) for r in range(2)]
+            for f in futs:
+                f.result(timeout=600)
+    finally:
+        store.shutdown()
+        lh.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if errors:
+        raise errors[0]
+    if "in_place_s" not in result:
+        raise RuntimeError("degrade never committed within the run")
+    if result.get("degrade_events") != 1:
+        raise RuntimeError(
+            f"expected exactly one degrade event, saw "
+            f"{result.get('degrade_events')}"
+        )
+    if min_participants[0] != 2:
+        raise RuntimeError(
+            f"quorum shrank to {min_participants[0]} during the in-place "
+            "degrade — the replica left instead of shrinking"
+        )
+    return {
+        "size_mb": size_mb,
+        "degree": degree,
+        "in_place_reshard_s": round(result["degraded_reshard_s"], 3),
+        "in_place_commit_window_s": round(result["in_place_s"], 3),
+        "group_degree_after": result["group_degree_after"],
+        "quorum_never_shrank": True,
+        **{f"reshard_{k}": v for k, v in result["reshard_stats"].items()},
+    }
+
+
+def run(smoke: bool) -> dict:
+    sizes = SMOKE_SIZES_MB if smoke else FULL_SIZES_MB
+    steps, kill_at = (6, 2) if smoke else (10, 3)
+    curve = []
+    for s in sizes:
+        ip = in_place_point(s, steps=steps, kill_at=kill_at)
+        cl = classic_point(s, steps=steps, kill_at=kill_at)
+        curve.append(
+            {
+                **cl,
+                **ip,
+                "speedup_x": round(
+                    cl["classic_rejoin_s"] / ip["in_place_reshard_s"], 2
+                ),
+            }
+        )
+    at_max = curve[-1]
+    return {
+        "degrade_curve": curve,
+        "degrade_size_mb_at_max": at_max["size_mb"],
+        "degrade_in_place_s_at_max": at_max["in_place_reshard_s"],
+        "degrade_commit_window_s_at_max": at_max["in_place_commit_window_s"],
+        "degrade_classic_rejoin_s_at_max": at_max["classic_rejoin_s"],
+        "degrade_speedup_x": at_max["speedup_x"],
+        "degrade_quorum_never_shrank": all(
+            p["quorum_never_shrank"] for p in curve
+        ),
+        "degrade_bitwise_ok": True,  # reshard hook raises otherwise
+        "provenance": (
+            "loopback host; classic leg = recovery_bench kill + restart + "
+            "full-state HTTP heal (rejoin_s: the replica's whole time out "
+            "of the loop), in-place leg = real managed fleet with "
+            "TORCHFT_DEGRADE=on, one chip of a 4-chip group killed, lost "
+            "shard (state/4) fetched over a real ShardStore GET inside the "
+            "timed reshard (degraded_reshard_s: the latency ADDED to the "
+            "one re-planned slow step — the replica never stops training, "
+            "so the steady step it rides is not counted as downtime; the "
+            "raw kill->commit window is in_place_commit_window_s). Shard "
+            "staging cost excluded (redundancy plane, bench.py "
+            "--recovery). Ratios are the claim."
+        ),
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument(
+        "--out",
+        default=os.path.join(REPO_ROOT, "BENCH_DEGRADE.json"),
+        help="degrade-curve output path (full runs only; '-' disables)",
+    )
+    args = parser.parse_args(argv)
+
+    result = run(smoke=args.smoke)
+    if not args.smoke and args.out != "-":
+        with open(args.out, "w") as f:
+            json.dump(
+                {
+                    "bench": "degrade plane (in-place TP shrink vs "
+                    "leave-heal-rejoin)",
+                    "harness": "benchmarks/degrade_bench.py",
+                    **result,
+                },
+                f,
+                indent=1,
+                sort_keys=True,
+            )
+            f.write("\n")
+        print(f"[degrade_bench] wrote {args.out}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "in-place degrade speedup over leave-heal-rejoin",
+        "value": result["degrade_speedup_x"],
+        "unit": "x",
+        "vs_baseline": result["degrade_speedup_x"],
+        **result,
+    }))
+
+
+if __name__ == "__main__":
+    main()

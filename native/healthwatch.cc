@@ -55,6 +55,7 @@ const char* health_state_name(HealthState s) {
     case HealthState::kWarn: return "warn";
     case HealthState::kEjected: return "ejected";
     case HealthState::kProbation: return "probation";
+    case HealthState::kDegraded: return "degraded";
   }
   return "ok";
 }
@@ -142,6 +143,15 @@ void HealthLedger::evaluate(const std::string& rid, TimePoint now,
   ReplicaHealth& rh = it->second;
   double s = rh.score;
 
+  if (rh.state == HealthState::kDegraded) {
+    // Capacity-scaled samples keep the peer statistics honest, but a
+    // degraded replica never accumulates strikes and never warns: it is
+    // slow-but-alive by declaration, and ejecting it would turn a
+    // survivable chip loss into a whole-group loss.
+    rh.strikes = 0;
+    return;
+  }
+
   if (rh.state == HealthState::kProbation) {
     if (s > opts_.eject_z) {  // one strike in probation: straight back out
       if (opts_.mode == "eject" && can_eject(now)) {
@@ -226,6 +236,42 @@ std::vector<Json> HealthLedger::on_heartbeat(const std::string& rid,
       // wall time across the quorum (everyone waits for the straggler), so
       // the straggler is the replica with high step_s minus wire wait.
       double sample = std::max(step_s - wire_s, 0.0);
+      // Degrade plane: a replica at reduced group degree self-reports its
+      // capacity; its compute sample is scaled to the full-capacity
+      // equivalent so it is scored against what the step SHOULD cost and
+      // never strike-ejected for being legitimately slower. Beats without
+      // both keys take the exact pre-degrade path.
+      if (telemetry->contains("group_world_size") &&
+          telemetry->contains("full_group_world_size")) {
+        int64_t gws = telemetry->get("group_world_size").as_int();
+        int64_t full = telemetry->get("full_group_world_size").as_int();
+        rh.group_world_size = gws;
+        rh.full_group_world_size = full;
+        if (0 < gws && gws < full) {
+          sample *= static_cast<double>(gws) / static_cast<double>(full);
+          if (rh.state == HealthState::kOk ||
+              rh.state == HealthState::kWarn) {
+            rh.state = HealthState::kDegraded;
+            rh.strikes = 0;
+            Json e = Json::object();
+            e["kind"] = std::string("degrade");
+            e["replica_id"] = rid;
+            e["group_world_size"] = gws;
+            e["full_group_world_size"] = full;
+            e["ms"] = epoch_millis_now();
+            events.push_back(e);
+          }
+        } else if (rh.state == HealthState::kDegraded && full > 0 &&
+                   gws >= full) {
+          rh.state = HealthState::kOk;
+          Json e = Json::object();
+          e["kind"] = std::string("restore");
+          e["replica_id"] = rid;
+          e["group_world_size"] = gws;
+          e["ms"] = epoch_millis_now();
+          events.push_back(e);
+        }
+      }
       rh.window.push_back(sample);
       while (static_cast<int64_t>(rh.window.size()) > opts_.window)
         rh.window.pop_front();
@@ -291,6 +337,10 @@ Json HealthLedger::replica_json(const std::string& rid) const {
   j["samples"] = rh.samples_total;
   j["ejections"] = rh.ejections;
   j["readmissions"] = rh.readmissions;
+  if (rh.full_group_world_size > 0) {
+    j["group_world_size"] = rh.group_world_size;
+    j["full_group_world_size"] = rh.full_group_world_size;
+  }
   return j;
 }
 
@@ -313,6 +363,10 @@ Json HealthLedger::to_json(TimePoint now) const {
     r["strikes"] = rh.strikes;
     r["ejections"] = rh.ejections;
     r["readmissions"] = rh.readmissions;
+    if (rh.full_group_world_size > 0) {
+      r["group_world_size"] = rh.group_world_size;
+      r["full_group_world_size"] = rh.full_group_world_size;
+    }
     r["last_beat_ms_ago"] = static_cast<int64_t>(
         std::chrono::duration_cast<Millis>(now - rh.last_beat).count());
     reps[rid] = r;

@@ -18,6 +18,12 @@
 //                       (event: readmit; replica leaves the exclusion set)
 //   probation -> ok     probe_ok clean samples; one sample over eject_z
 //                       re-ejects immediately
+//   ok/warn -> degraded telemetry reports group_world_size below
+//                       full_group_world_size: the replica lost a chip and
+//                       reshard onto survivors (event: degrade). Samples
+//                       are capacity-scaled, strikes never accrue, and the
+//                       state returns to ok once full degree is reported
+//                       again (event: restore).
 //
 // In "observe" mode (the default) the ledger scores and reports but never
 // ejects, so existing jobs see zero behavior change. The scoring math is
@@ -51,7 +57,15 @@ struct HealthOpts {
   Json to_json() const;
 };
 
-enum class HealthState { kOk = 0, kWarn = 1, kEjected = 2, kProbation = 3 };
+// kDegraded is appended (not renumbered): codes 0..3 are pinned by the
+// Python parity tests, Manager timings(), and the /metrics docs.
+enum class HealthState {
+  kOk = 0,
+  kWarn = 1,
+  kEjected = 2,
+  kProbation = 3,
+  kDegraded = 4,
+};
 const char* health_state_name(HealthState s);
 
 // Pure scoring: per-replica straggler score from rolling windows of
@@ -76,6 +90,9 @@ struct ReplicaHealth {
   int64_t samples_total = 0;
   TimePoint ejected_at{};
   TimePoint last_beat{};
+  // Degrade plane: last reported group degree (0 = never reported).
+  int64_t group_world_size = 0;
+  int64_t full_group_world_size = 0;
 };
 
 class HealthLedger {

@@ -18,7 +18,11 @@ must converge its quorum rounds and the aggregator tier must show a real
 fan-in reduction at the root. `--recovery --smoke` is the gate for the
 redundancy plane: the parallel erasure reconstruct must beat the
 single-source heal wire and the commit-path cost of shard staging must
-stay a small fraction of the managed step."""
+stay a small fraction of the managed step. `--degrade --smoke` is the
+gate for the degrade-in-place plane: killing one chip of a 4-chip
+replica group must reshard in place faster than the classic
+leave-heal-rejoin cycle with the quorum never shrinking and the
+shrunken layout bitwise-equal."""
 
 import json
 import os
@@ -158,6 +162,24 @@ def test_bench_recovery_smoke_beats_single_source_and_stays_cheap():
         assert row["shards_ok_parallel"] >= rec["recovery_k"]
         assert row["shards_ok_single"] == 1
         assert row["speedup_x"] > 0
+
+
+def test_bench_degrade_smoke_beats_rejoin_and_keeps_quorum():
+    rec = _run_bench("--degrade", "--smoke")
+    # the smoke run itself gates these (>=1.5x over leave-heal-rejoin,
+    # quorum never shrank, bitwise reshard); re-check the load-bearing
+    # ones here so a silently-weakened degrade() still fails CI
+    assert rec["degrade_speedup_x"] >= 1.5
+    assert rec["degrade_in_place_s_at_max"] > 0
+    assert rec["degrade_classic_rejoin_s_at_max"] > 0
+    assert rec["degrade_quorum_never_shrank"] is True
+    assert rec["degrade_bitwise_ok"] is True
+    for row in rec["degrade_curve"]:
+        # exactly one chip lost: the gather-free path sourced 1/degree of
+        # the state off the wire and the group landed one degree down
+        assert row["reshard_mode"] == "peer"
+        assert row["group_degree_after"] == row["degree"] - 1
+        assert 0 < row["reshard_bytes_sourced"] < row["reshard_bytes_moved"]
 
 
 def test_bench_serving_smoke_sustains_traffic_through_kill():

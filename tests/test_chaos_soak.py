@@ -1454,3 +1454,231 @@ def test_serving_kill_mid_traffic_drains_and_converges():
             err_msg=f"{w.name} diverged from the surviving publisher",
         )
         assert w.counters["pull_failovers_total"] >= 1, w.counters
+
+
+@pytest.mark.slow
+def test_chip_kill_degrades_in_place_restores_converges(monkeypatch):
+    """Degrade-plane chaos phase: one chip of the victim replica's
+    declared 4-chip group dies mid-soak (EventInjector.kill_chip through
+    the FakeProcessGroupWrapper's member-death path). The bar, end to
+    end: the victim reshards IN PLACE (real engine, gather-free
+    peer-sourced path, bitwise-verified inside the hook) instead of
+    leaving — the quorum never shrinks; the reduced capacity rides the
+    heartbeat telemetry into the native ledger, which walks the victim to
+    DEGRADED with ZERO strikes (capacity-scaled scoring, eject mode armed)
+    and drains it from serving; restore_full_degree() re-promotes it to
+    OK; counters tell the story (degrade_events==1, restored_events==1,
+    ejections==0); and the whole fleet still converges bitwise."""
+    monkeypatch.setenv("TORCHFT_DEGRADE", "on")
+    for env in ("TORCHFT_DEGRADE_MIN_DEGREE", "TORCHFT_DEGRADE_RESTORE"):
+        monkeypatch.delenv(env, raising=False)
+    from torchft_tpu._test.event_injector import EventInjector
+    from torchft_tpu.coordination import LighthouseClient
+    from torchft_tpu.healthwatch import serving_eligible
+    from torchft_tpu.parallel.degrade import (
+        assemble,
+        reshard_from_survivors,
+        split_even,
+    )
+    from torchft_tpu.process_group import FakeProcessGroupWrapper
+
+    n_replicas = 3
+    target = 24
+    victim = 0
+    dead_chip = 2
+    full_degree = 4
+    kill_step = 8
+    health = {
+        "mode": "eject",  # strikes are live — DEGRADED must never accrue any
+        "window": 8,
+        "min_samples": 3,
+        "warn_z": 2.0,
+        "eject_z": 4.0,
+        "eject_steps": 2,
+        "probation_ms": 1500,
+        "probe_ok": 2,
+    }
+
+    injector = EventInjector().kill_chip(victim, dead_chip, at_step=kill_step)
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800, health=health,
+    )
+    client = LighthouseClient(f"127.0.0.1:{lh.port}", connect_timeout=5.0)
+    finals: dict = {}
+    participants: dict = {r: {} for r in range(n_replicas)}
+    reshard_evidence: dict = {}
+    managers: dict = {}
+    fleet_done = threading.Event()
+    failure: list = []
+
+    def replica(rid: int) -> None:
+        grad_base = np.random.RandomState(900 + rid).randn(8).astype(
+            np.float32
+        )
+        params = {"w": np.zeros(8, np.float32)}
+
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=8.0))
+        manager = Manager(
+            pg=pg,
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id=f"degsoak_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=8.0,
+            quorum_timeout=4.0,
+            heartbeat_interval=0.02,
+        )
+        managers[rid] = manager
+        if rid == victim:
+            manager.set_group_degree(full_degree)
+
+            def reshard(dead_rank, new_degree):
+                # the real gather-free engine against the live params: the
+                # survivors' shards stay put, only the dead chip's shard is
+                # peer-sourced, and the shrunken layout must reassemble
+                # bitwise before the step is allowed to continue
+                axes = {"w": 0}
+                shards = split_even(params["w"], full_degree, 0)
+                lost = shards[dead_rank].copy()
+                rank_trees = [
+                    None if r == dead_rank else {"w": shards[r]}
+                    for r in range(full_degree)
+                ]
+                trees, stats = reshard_from_survivors(
+                    rank_trees, dead_rank, axes,
+                    shard_source=lambda path: lost,
+                )
+                re = assemble(trees, axes)
+                np.testing.assert_array_equal(re["w"], params["w"])
+                reshard_evidence["stats"] = stats
+                reshard_evidence["call"] = (dead_rank, new_degree)
+                return stats.to_json()
+
+            manager.set_reshard_fn(reshard)
+        zgrads = {"w": np.zeros(8, np.float32)}
+        try:
+            while manager.current_step() < target:
+                manager.start_quorum()
+                if manager.current_step() >= target:
+                    manager.allreduce(zgrads).get_future().wait(30)
+                    if manager.should_commit():
+                        break
+                    continue
+                step = manager.current_step()
+                g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+                avg = manager.allreduce({"w": g}).get_future().wait(30)
+                if manager.should_commit():
+                    params["w"] = (
+                        params["w"] - LR * np.asarray(avg["w"])
+                    ).astype(np.float32)
+                    participants[rid][step] = manager.num_participants()
+                    if rid == victim:
+                        injector.check(rid, step, pg=pg)
+            finals[rid] = params["w"].copy()
+            if len(finals) == n_replicas:
+                fleet_done.set()
+            while not fleet_done.is_set():
+                manager.start_quorum()
+                manager.allreduce(zgrads).get_future().wait(30)
+                manager.should_commit()
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+
+    def victim_record(payload: dict) -> dict:
+        for rid, rec in payload.get("replicas", {}).items():
+            if rid.startswith(f"degsoak_{victim}"):
+                return rec
+        return {}
+
+    phases: dict = {}
+    ex = ThreadPoolExecutor(max_workers=n_replicas)
+    try:
+        futs = [ex.submit(replica, r) for r in range(n_replicas)]
+        deadline = time.monotonic() + 180.0
+        while not fleet_done.is_set() and time.monotonic() < deadline:
+            if failure:
+                break
+            try:
+                payload = client.health(timeout=2.0)
+            except Exception:  # noqa: BLE001 — poll races shutdown
+                payload = {}
+            rec = victim_record(payload)
+            if rec.get("state") == "degraded" and "degraded" not in phases:
+                phases["degraded"] = rec
+                phases["excluded_at_degrade"] = list(
+                    payload.get("excluded", [])
+                )
+            if "degraded" in phases and "restore_sent" not in phases:
+                phases["restore_sent"] = True
+                managers[victim].restore_full_degree()
+            if (
+                "restore_sent" in phases
+                and "restored" not in phases
+                and rec.get("state") == "ok"
+                and rec.get("group_world_size") == full_degree
+            ):
+                phases["restored"] = rec
+            time.sleep(0.02)
+        final_health = client.health()
+        for f in futs:
+            f.result(timeout=max(5.0, deadline - time.monotonic()))
+    finally:
+        fleet_done.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+
+    assert not failure, failure
+    # the degrade happened in place, once, through the real engine
+    assert reshard_evidence.get("call") == (dead_chip, full_degree - 1)
+    assert reshard_evidence["stats"].mode == "peer"
+    assert 0 < reshard_evidence["stats"].bytes_sourced < (
+        reshard_evidence["stats"].bytes_moved
+    )
+    t = managers[victim].timings()
+    assert t.get("degrade_events", 0) == 1, t
+    assert t.get("degraded_reshard_s", 0) > 0, t
+    assert t.get("restored_events", 0) == 1, t
+    # the ledger walked the victim DEGRADED -> (restore) -> OK, with zero
+    # strikes and zero ejections the whole way, and serving drained it
+    assert "degraded" in phases, final_health
+    deg = phases["degraded"]
+    assert deg.get("group_world_size") == full_degree - 1, deg
+    assert deg.get("full_group_world_size") == full_degree, deg
+    assert deg.get("strikes") == 0, deg
+    assert not serving_eligible(deg["state"], drain_on="warn")
+    assert not serving_eligible(deg["state"], drain_on="eject")
+    assert phases["excluded_at_degrade"] == [], phases
+    assert "restored" in phases, (phases.keys(), final_health)
+    assert serving_eligible(phases["restored"]["state"], drain_on="warn")
+    kinds = [e.get("kind") for e in final_health.get("recent_events", [])]
+    assert "degrade" in kinds and "restore" in kinds, kinds
+    assert "eject" not in kinds, kinds
+    rec = victim_record(final_health)
+    assert rec.get("ejections", 0) == 0, rec
+    assert rec.get("strikes", 1) == 0, rec
+    # the quorum NEVER shrank: every committed step past warmup saw the
+    # full fleet, on every replica — the victim stayed in as a slower
+    # member instead of leaving to heal
+    for rid in range(n_replicas):
+        steady = {
+            s: n for s, n in participants[rid].items() if s >= kill_step - 2
+        }
+        assert steady, participants[rid]
+        assert set(steady.values()) == {n_replicas}, (rid, steady)
+    # and the fleet still agrees bitwise
+    assert set(finals) == set(range(n_replicas)), finals.keys()
+    for rid in range(1, n_replicas):
+        np.testing.assert_array_equal(
+            finals[0], finals[rid],
+            err_msg=f"replica {rid} diverged across the in-place degrade",
+        )
+    assert np.isfinite(finals[0]).all()

@@ -1,0 +1,634 @@
+"""Degrade-in-place plane tests (docs/operations.md#degraded-replicas).
+
+Engine level: split/assemble and both reshard paths (gather-free
+peer-sourced and full redistribution) must be bitwise-equal to the
+pre-fault params, with honest DegradeStats. Spec level: the mesh/pipeline
+hooks must project llama PartitionSpecs onto per-leaf reshard axes.
+PG level: ProcessGroupXLA.prepare_shrink fences the local-mode collective
+generation and refuses distributed mode. Manager level: an injected chip
+death inside a replica group stages a degrade, commits it at the next
+safe point (reshard hook + PG shrink + counters), keeps the quorum at
+full strength, and falls back to the classic leave-heal-rejoin path when
+the surviving degree is too small or the reshard fails. And the off
+path (TORCHFT_DEGRADE unset — the default) is pinned byte-identical:
+the degrade commit hook never runs at all (TestManagerKZeroPin shape,
+tests/test_redundancy.py).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchft_tpu.parallel.degrade import (
+    DegradeConfig,
+    DegradeError,
+    assemble,
+    axes_from_specs,
+    reshard_from_survivors,
+    reshard_full,
+    split_even,
+)
+
+
+# ------------------------------------------------------------------ engine
+class TestEngine:
+    def test_split_assemble_roundtrip_bitwise_uneven(self):
+        # 7 rows over 3 chips: np.array_split semantics, first n%d chunks
+        # take the extra row — concatenation must be bitwise-exact
+        rng = np.random.RandomState(0)
+        arr = rng.randn(7, 5).astype(np.float32)
+        shards = split_even(arr, 3, 0)
+        assert [s.shape[0] for s in shards] == [3, 2, 2]
+        np.testing.assert_array_equal(np.concatenate(shards, axis=0), arr)
+
+    def test_split_validates_degree_and_axis(self):
+        with pytest.raises(DegradeError):
+            split_even(np.ones((4,)), 0, 0)
+        with pytest.raises(DegradeError):
+            split_even(np.ones((4,)), 2, 1)  # rank-1 has no axis 1
+
+    def _tree(self, rows=12):
+        rng = np.random.RandomState(7)
+        full = {
+            "w": rng.randn(rows, 6).astype(np.float32),
+            "b": rng.randn(3).astype(np.float32),  # replicated
+        }
+        axes = {"w": 0, "b": None}
+        return full, axes
+
+    def test_reshard_full_bitwise_and_stats(self):
+        full, axes = self._tree()
+        trees, stats = reshard_full(full, axes, 3)
+        assert len(trees) == 3
+        re = assemble(trees, axes)
+        np.testing.assert_array_equal(re["w"], full["w"])
+        np.testing.assert_array_equal(re["b"], full["b"])
+        assert stats.mode == "full"
+        assert stats.leaves_total == 2
+        assert stats.leaves_sharded == 1
+        assert stats.leaves_replicated == 1
+        assert stats.bytes_moved == full["w"].nbytes
+        assert stats.bytes_sourced == 0
+
+    def test_reshard_from_survivors_peer_bitwise_and_stats(self):
+        full, axes = self._tree()
+        k, dead = 4, 1
+        per_rank = [
+            {"w": s, "b": full["b"]} for s in split_even(full["w"], k, 0)
+        ]
+        lost = per_rank[dead]["w"].copy()
+        rank_trees = [
+            None if r == dead else per_rank[r] for r in range(k)
+        ]
+        trees, stats = reshard_from_survivors(
+            rank_trees, dead, axes, shard_source=lambda path: lost
+        )
+        assert len(trees) == k - 1
+        re = assemble(trees, axes)
+        np.testing.assert_array_equal(re["w"], full["w"])
+        np.testing.assert_array_equal(re["b"], full["b"])
+        assert stats.mode == "peer"
+        # gather-free: only the dead rank's shard crossed the group edge
+        assert stats.bytes_sourced == lost.nbytes
+        assert 0 < stats.bytes_sourced < stats.bytes_moved
+
+    def test_reshard_from_survivors_without_source_raises(self):
+        full, axes = self._tree()
+        per_rank = [
+            {"w": s, "b": full["b"]} for s in split_even(full["w"], 2, 0)
+        ]
+        with pytest.raises(DegradeError, match="no shard_source"):
+            reshard_from_survivors([per_rank[0], None], 1, axes)
+
+    def test_reshard_from_survivors_validates_group(self):
+        _, axes = self._tree()
+        with pytest.raises(DegradeError, match="out of range"):
+            reshard_from_survivors([{}, {}], 5, axes)
+        with pytest.raises(DegradeError, match="1-chip"):
+            reshard_from_survivors([{}], 0, axes)
+
+
+# ------------------------------------------------------------------ config
+class TestConfig:
+    def test_defaults_off(self, monkeypatch):
+        for env in (
+            "TORCHFT_DEGRADE",
+            "TORCHFT_DEGRADE_MIN_DEGREE",
+            "TORCHFT_DEGRADE_RESTORE",
+        ):
+            monkeypatch.delenv(env, raising=False)
+        cfg = DegradeConfig.from_env()
+        assert cfg.enabled is False
+        assert cfg.min_degree == 1
+        assert cfg.restore == "auto"
+
+    def test_on_with_knobs(self, monkeypatch):
+        monkeypatch.setenv("TORCHFT_DEGRADE", "on")
+        monkeypatch.setenv("TORCHFT_DEGRADE_MIN_DEGREE", "2")
+        monkeypatch.setenv("TORCHFT_DEGRADE_RESTORE", "manual")
+        cfg = DegradeConfig.from_env()
+        assert cfg.enabled is True
+        assert cfg.min_degree == 2
+        assert cfg.restore == "manual"
+
+    @pytest.mark.parametrize(
+        "env,val",
+        [
+            ("TORCHFT_DEGRADE", "maybe"),
+            ("TORCHFT_DEGRADE_MIN_DEGREE", "zero"),
+            ("TORCHFT_DEGRADE_MIN_DEGREE", "0"),
+            ("TORCHFT_DEGRADE_RESTORE", "yolo"),
+        ],
+    )
+    def test_junk_raises_valueerror(self, monkeypatch, env, val):
+        monkeypatch.setenv("TORCHFT_DEGRADE", "on")
+        monkeypatch.setenv(env, val)
+        with pytest.raises(ValueError):
+            DegradeConfig.from_env()
+
+
+# ------------------------------------------------------------ spec hooks
+class TestSpecHooks:
+    def _cfg(self):
+        import jax.numpy as jnp
+
+        from torchft_tpu.models.llama import LlamaConfig
+
+        return LlamaConfig(
+            vocab_size=64, dim=16, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_hidden=32, max_seq_len=16, dtype=jnp.float32,
+        )
+
+    def test_degrade_axes_projects_llama_tp_specs(self):
+        from torchft_tpu.parallel.mesh import degrade_axes
+
+        axes = degrade_axes(self._cfg(), "tp")
+        # column-parallel shards the output dim, row-parallel the input dim
+        assert axes["layers"]["wq"] == 2
+        assert axes["layers"]["wo"] == 1
+        assert axes["embed"] == 1
+        assert axes["lm_head"] == 1
+        # norms are replicated over tp: nothing to reshard
+        assert axes["layers"]["attn_norm"] is None
+        assert axes["final_norm"] is None
+
+    def test_pp_degrade_axes_shrinks_layer_stacks(self):
+        from torchft_tpu.parallel.pipeline import pp_degrade_axes
+
+        axes = pp_degrade_axes(self._cfg())
+        # every layer stack loses a stage along dim 0 ...
+        for leaf in axes["layers"].values():
+            assert leaf == 0
+        # ... and the replicated embed/head/norm never move
+        assert axes["embed"] is None
+        assert axes["lm_head"] is None
+        assert axes["final_norm"] is None
+
+    def test_axes_from_specs_handles_tuple_entries(self):
+        from jax.sharding import PartitionSpec as P
+
+        axes = axes_from_specs({"x": P(("dp", "tp"), None)}, "tp")
+        assert axes["x"] == 0
+
+    def test_shrink_mesh_drops_one_slice_keeps_specs_valid(self):
+        import jax
+
+        from torchft_tpu.parallel.mesh import shrink_mesh
+        from jax.sharding import Mesh
+
+        devs = np.asarray(jax.devices("cpu")[:4]).reshape(1, 4)
+        mesh = Mesh(devs, ("dp", "tp"))
+        small = shrink_mesh(mesh, "tp", 2)
+        assert small.axis_names == ("dp", "tp")
+        assert np.asarray(small.devices).shape == (1, 3)
+        # the dead chip's slice is gone, order otherwise preserved
+        kept = [d.id for d in np.asarray(small.devices).ravel()]
+        assert kept == [devs[0, 0].id, devs[0, 1].id, devs[0, 3].id]
+
+    def test_shrink_mesh_validates(self):
+        import jax
+
+        from torchft_tpu.parallel.mesh import shrink_mesh
+        from jax.sharding import Mesh
+
+        devs = np.asarray(jax.devices("cpu")[:2]).reshape(2, 1)
+        mesh = Mesh(devs, ("dp", "tp"))
+        with pytest.raises(ValueError, match="no axis"):
+            shrink_mesh(mesh, "pp", 0)
+        with pytest.raises(ValueError, match="nothing to shrink"):
+            shrink_mesh(mesh, "tp", 0)  # degree-1 axis
+        with pytest.raises(ValueError, match="out of range"):
+            shrink_mesh(mesh, "dp", 5)
+
+
+# ------------------------------------------------------- PG prepare_shrink
+class TestPrepareShrink:
+    def test_unconfigured_pg_has_nothing_to_shrink(self):
+        from torchft_tpu.process_group_xla import ProcessGroupXLA
+
+        pg = ProcessGroupXLA(timeout=5.0, mode="local")
+        assert pg.prepare_shrink(0) is None
+
+    def test_local_mode_commit_rebuilds_working_world(self):
+        import jax.numpy as jnp
+        from concurrent.futures import ThreadPoolExecutor
+
+        from torchft_tpu.coordination import KvStoreServer
+        from torchft_tpu.process_group import ReduceOp
+        from torchft_tpu.process_group_xla import ProcessGroupXLA
+
+        store = KvStoreServer("127.0.0.1:0")
+        world = 2
+        try:
+            pgs = [
+                ProcessGroupXLA(timeout=30.0, mode="local")
+                for _ in range(world)
+            ]
+            addr = f"127.0.0.1:{store.port}/shrink"
+            with ThreadPoolExecutor(max_workers=world) as ex:
+                list(
+                    ex.map(
+                        lambda r: pgs[r].configure(addr, r, world, 1),
+                        range(world),
+                    )
+                )
+                commits = [pgs[r].prepare_shrink(1) for r in range(world)]
+                assert all(c is not None for c in commits)
+                # commit poisons the stale generation and re-lands the same
+                # world coordinates; both members rendezvous into the fresh
+                # generation and collectives keep working
+                list(ex.map(lambda c: c(), commits))
+                outs = list(
+                    ex.map(
+                        lambda r: pgs[r]
+                        .allreduce(
+                            [jnp.full((4,), float(r + 1))], ReduceOp.SUM
+                        )
+                        .get_future()
+                        .wait(30),
+                        range(world),
+                    )
+                )
+            np.testing.assert_allclose(np.asarray(outs[0][0]), np.full(4, 3.0))
+        finally:
+            store.shutdown()
+
+    def test_distributed_mode_refuses_in_place_shrink(self):
+        import types
+
+        from torchft_tpu.process_group_xla import ProcessGroupXLA
+
+        pg = ProcessGroupXLA(timeout=5.0, mode="local")
+        # a jax.distributed world's membership only changes by teardown +
+        # rejoin; prepare_shrink must refuse rather than wedge the runtime
+        pg._world = types.SimpleNamespace(distributed=True)
+        pg._last_configure = ("127.0.0.1:1/x", 0, 1, 1)
+        with pytest.raises(RuntimeError, match="leave-heal-rejoin"):
+            pg.prepare_shrink(0)
+
+
+# ------------------------------------------------- injection plumbing
+class TestKillChipInjection:
+    def test_kill_chip_fires_death_callback_once(self):
+        from torchft_tpu._test.event_injector import EventInjector
+        from torchft_tpu.process_group import (
+            FakeProcessGroupWrapper,
+            ProcessGroupHost,
+        )
+
+        pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=5.0))
+        deaths = []
+        pg.set_member_death_callback(deaths.append)
+        injector = EventInjector().kill_chip(0, group_rank=2, at_step=3)
+        injector.check(0, 2, pg=pg)
+        assert deaths == [] and pg.dead_members == []
+        injector.check(0, 3, pg=pg)
+        assert deaths == [2]
+        assert pg.dead_members == [2]
+        injector.check(0, 3, pg=pg)  # events fire at most once
+        assert deaths == [2]
+
+    def test_kill_chip_requires_capable_pg(self):
+        from torchft_tpu._test.event_injector import EventInjector
+
+        injector = EventInjector().kill_chip(0, group_rank=1, at_step=0)
+        with pytest.raises(AssertionError, match="kill_chip"):
+            injector.check(0, 0, pg=None)
+
+
+# ---------------------------------------------------- manager integration
+def _fleet(monkeypatch, train, n_replicas=2, join_timeout_ms=2000):
+    """Run ``train(rid, out, lighthouse_addr)`` per replica in threads."""
+    from torchft_tpu.coordination import LighthouseServer
+
+    lh = LighthouseServer(
+        bind="127.0.0.1:0",
+        min_replicas=n_replicas,
+        join_timeout_ms=join_timeout_ms,
+        quorum_tick_ms=20,
+        heartbeat_timeout_ms=2000,
+    )
+    out = {}
+    errors = []
+
+    def runner(rid):
+        try:
+            train(rid, out, f"127.0.0.1:{lh.port}")
+        except Exception as e:  # noqa: BLE001
+            errors.append((rid, e))
+
+    try:
+        threads = [
+            threading.Thread(target=runner, args=(rid,))
+            for rid in range(n_replicas)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        lh.shutdown()
+    assert not errors, f"replica failures: {errors}"
+    assert set(range(n_replicas)) <= set(out), "a replica never finished"
+    return out
+
+
+class TestManagerDegrade:
+    def test_chip_death_shrinks_in_place_quorum_intact(self, monkeypatch):
+        """Kill one chip of replica 0's declared 4-chip group mid-run: the
+        staged degrade commits at the next safe point (reshard hook fires
+        with (dead_rank, new_degree)), the counters/timings surface it,
+        the quorum never drops below both replicas, and both replicas
+        still converge bitwise. restore_full_degree() then re-promotes."""
+        monkeypatch.setenv("TORCHFT_DEGRADE", "on")
+        monkeypatch.delenv("TORCHFT_DEGRADE_MIN_DEGREE", raising=False)
+        from torchft_tpu._test.event_injector import EventInjector
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.process_group import (
+            FakeProcessGroupWrapper,
+            ProcessGroupHost,
+        )
+
+        injector = EventInjector().kill_chip(0, group_rank=2, at_step=1)
+        reshard_calls = []
+        observed = {"min_participants": 99}
+        managers = {}
+
+        def train(rid, out, lh_addr):
+            params = {"w": np.full(8, float(rid), np.float32)}
+
+            def load_state(sd):
+                params["w"] = np.array(sd["w"], dtype=np.float32)
+
+            pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
+            manager = Manager(
+                pg=pg,
+                load_state_dict=load_state,
+                state_dict=lambda: {"w": params["w"].copy()},
+                min_replica_size=2,
+                use_async_quorum=True,
+                replica_id=f"degrade_{rid}",
+                lighthouse_addr=lh_addr,
+                timeout=10.0,
+                quorum_timeout=10.0,
+            )
+            managers[rid] = manager
+            if rid == 0:
+                manager.set_group_degree(4)
+
+                def reshard(dead_rank, new_degree):
+                    reshard_calls.append((dead_rank, new_degree))
+                    return {"mode": "test"}
+
+                manager.set_reshard_fn(reshard)
+            try:
+                while manager.current_step() < 5:
+                    step = manager.current_step()
+                    manager.start_quorum()
+                    grads = {"w": np.ones(8, np.float32)}
+                    reduced = manager.allreduce(grads).get_future().wait(
+                        timeout=30
+                    )
+                    if manager.should_commit():
+                        params["w"] = params["w"] - 0.1 * reduced["w"]
+                        if rid == 0:
+                            # fire between steps, the abort-watchdog shape
+                            injector.check(rid, step, pg=pg)
+                        else:
+                            observed["min_participants"] = min(
+                                observed["min_participants"],
+                                manager.num_participants(),
+                            )
+                out[rid] = params["w"].copy()
+            finally:
+                t = manager.timings()
+                out[f"timings_{rid}"] = t
+                if rid == 0:
+                    out["degree_mid"] = manager.group_degree
+                    manager.restore_full_degree()
+                    manager.restore_full_degree()  # idempotent
+                    out["degree_restored"] = manager.group_degree
+                    out["timings_restored"] = manager.timings()
+                    out["dead_members"] = pg.dead_members
+                manager.shutdown(wait=False)
+
+        out = _fleet(monkeypatch, train)
+        # the degrade happened, in place, exactly once
+        assert reshard_calls == [(2, 3)]
+        t0 = out["timings_0"]
+        assert t0.get("degrade_events", 0) == 1
+        assert t0.get("degraded_reshard_s", 0) > 0
+        assert out["degree_mid"] == 3
+        assert out["dead_members"] == [2]
+        # the group never left: replica 1 always saw a 2-participant quorum
+        assert observed["min_participants"] == 2
+        # the fleet still agrees bitwise
+        np.testing.assert_array_equal(out[0], out[1])
+        # restore re-promoted to full degree, once
+        assert out["degree_restored"] == 4
+        assert out["timings_restored"].get("restored_events", 0) == 1
+        # the off-replica saw no degrade plumbing of its own
+        assert out["timings_1"].get("degrade_events", 0) == 0
+
+    def test_below_min_degree_falls_back_to_leave_heal_rejoin(
+        self, monkeypatch
+    ):
+        """A death that would shrink below TORCHFT_DEGRADE_MIN_DEGREE must
+        take the classic path: the reshard hook never fires, no degrade is
+        counted, the step's vote fails once, and the group heals back into
+        bitwise agreement."""
+        monkeypatch.setenv("TORCHFT_DEGRADE", "on")
+        monkeypatch.setenv("TORCHFT_DEGRADE_MIN_DEGREE", "2")
+        self._run_fallback_fleet(
+            monkeypatch, degree=2, reshard_raises=False
+        )
+
+    def test_reshard_failure_falls_back_to_leave_heal_rejoin(
+        self, monkeypatch
+    ):
+        """A reshard hook that raises must not half-degrade the group: the
+        degree stays full, nothing is counted, and the classic error path
+        heals the replica back to agreement."""
+        monkeypatch.setenv("TORCHFT_DEGRADE", "on")
+        monkeypatch.delenv("TORCHFT_DEGRADE_MIN_DEGREE", raising=False)
+        self._run_fallback_fleet(
+            monkeypatch, degree=4, reshard_raises=True
+        )
+
+    def _run_fallback_fleet(self, monkeypatch, degree, reshard_raises):
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.process_group import (
+            FakeProcessGroupWrapper,
+            ProcessGroupHost,
+        )
+
+        reshard_calls = []
+        uncommitted = []
+
+        def train(rid, out, lh_addr):
+            params = {"w": np.full(8, float(rid), np.float32)}
+
+            def load_state(sd):
+                params["w"] = np.array(sd["w"], dtype=np.float32)
+
+            pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
+            manager = Manager(
+                pg=pg,
+                load_state_dict=load_state,
+                state_dict=lambda: {"w": params["w"].copy()},
+                min_replica_size=1,
+                use_async_quorum=True,
+                replica_id=f"fallback_{rid}",
+                lighthouse_addr=lh_addr,
+                timeout=10.0,
+                quorum_timeout=10.0,
+            )
+            if rid == 0:
+                manager.set_group_degree(degree)
+
+                def reshard(dead_rank, new_degree):
+                    reshard_calls.append((dead_rank, new_degree))
+                    if reshard_raises:
+                        raise RuntimeError("injected reshard failure")
+                    return None
+
+                manager.set_reshard_fn(reshard)
+            try:
+                killed = False
+                while manager.current_step() < 5:
+                    manager.start_quorum()
+                    grads = {"w": np.ones(8, np.float32)}
+                    reduced = manager.allreduce(grads).get_future().wait(
+                        timeout=30
+                    )
+                    if manager.should_commit():
+                        params["w"] = params["w"] - 0.1 * reduced["w"]
+                        if rid == 0 and not killed:
+                            killed = True
+                            pg.inject_group_member_death(degree - 1)
+                    elif rid == 0:
+                        uncommitted.append(manager.current_step())
+                out[rid] = params["w"].copy()
+            finally:
+                out[f"timings_{rid}"] = manager.timings()
+                if rid == 0:
+                    out["degree_final"] = manager.group_degree
+                manager.shutdown(wait=False)
+
+        out = _fleet(monkeypatch, train)
+        if reshard_raises:
+            # the hook fired and raised; the Manager rolled the step back
+            assert reshard_calls, "reshard hook never reached"
+        else:
+            # below min_degree the hook is never even consulted
+            assert reshard_calls == []
+        t0 = out["timings_0"]
+        assert t0.get("degrade_events", 0) == 0
+        assert out["degree_final"] == degree
+        # the fallback discarded at least one step on the way out ...
+        assert uncommitted, "fallback never failed a step's vote"
+        # ... and the classic heal path still converged the fleet bitwise
+        np.testing.assert_array_equal(out[0], out[1])
+
+
+# ------------------------------------------------------------ off-path pin
+class TestDegradeOffPin:
+    """TORCHFT_DEGRADE unset (the default) must leave every Manager/PG
+    code path byte-identical to pre-degrade behavior (TestManagerKZeroPin
+    shape, tests/test_redundancy.py): no config attaches, no death
+    callback registers, and the degrade commit hook never executes."""
+
+    def test_off_never_touches_degrade_path(self, monkeypatch):
+        for env in (
+            "TORCHFT_DEGRADE",
+            "TORCHFT_DEGRADE_MIN_DEGREE",
+            "TORCHFT_DEGRADE_RESTORE",
+        ):
+            monkeypatch.delenv(env, raising=False)
+        from torchft_tpu.manager import Manager
+        from torchft_tpu.process_group import (
+            FakeProcessGroupWrapper,
+            ProcessGroupHost,
+        )
+
+        calls = []
+        real = Manager._commit_pending_degrade
+
+        def spying(self):
+            calls.append(self._replica_id)
+            return real(self)
+
+        monkeypatch.setattr(Manager, "_commit_pending_degrade", spying)
+        wrappers = {}
+
+        def train(rid, out, lh_addr):
+            rng = np.random.RandomState(rid + 1)
+            params = {"w": rng.randn(4).astype(np.float32)}  # divergent
+
+            def load_state(sd):
+                params["w"] = np.array(sd["w"], dtype=np.float32)
+
+            pg = FakeProcessGroupWrapper(ProcessGroupHost(timeout=10.0))
+            wrappers[rid] = pg
+            manager = Manager(
+                pg=pg,
+                load_state_dict=load_state,
+                state_dict=lambda: {"w": params["w"].copy()},
+                min_replica_size=1,
+                use_async_quorum=True,
+                replica_id=f"degoff_{rid}",
+                lighthouse_addr=lh_addr,
+                timeout=10.0,
+                quorum_timeout=10.0,
+            )
+            assert manager._degrade_cfg is None
+            try:
+                while manager.current_step() < 3:
+                    manager.start_quorum()
+                    grads = {"w": np.ones(4, np.float32)}
+                    reduced = manager.allreduce(grads).get_future().wait(
+                        timeout=30
+                    )
+                    if manager.should_commit():
+                        params["w"] = params["w"] - 0.1 * reduced["w"]
+                out[rid] = params["w"].copy()
+                out[f"timings_{rid}"] = manager.timings()
+            finally:
+                manager.shutdown(wait=False)
+
+        out = _fleet(monkeypatch, train)
+        # divergent inits ended identical => the normal FT path ran ...
+        np.testing.assert_array_equal(out[0], out[1])
+        # ... and the degrade plane never executed or registered anything
+        assert calls == []
+        for rid, pg in wrappers.items():
+            assert pg._member_death_cb is None, rid
+        for rid in (0, 1):
+            t = out[f"timings_{rid}"]
+            # counters are declared (zero) even when off; the pin is that
+            # nothing ever moved them and no reshard was ever timed
+            assert t.get("degrade_events", 0) == 0
+            assert t.get("restored_events", 0) == 0
+            assert not t.get("degraded_reshard_s")

@@ -290,6 +290,132 @@ class TestLedgerPolicy:
         assert [e["kind"] for e in events] == ["readmit"]
 
 
+# ----------------------------------------------------------- degraded
+class TestDegraded:
+    """The DEGRADED state (degrade-in-place plane): a replica reporting
+    reduced group capacity is scored against capacity-scaled expected
+    step time, never strike-counted, drained from serving, and
+    re-promoted when full degree restores."""
+
+    def _beat(self, ledger, rid, step, step_s, now, gws=None, full=None):
+        telemetry = {"step": step, "step_s": step_s, "wire_s": 0.0}
+        if gws is not None:
+            telemetry["group_world_size"] = gws
+            telemetry["full_group_world_size"] = full
+        return ledger.on_heartbeat(rid, telemetry, now)
+
+    def test_reduced_capacity_beat_enters_degraded(self):
+        ledger = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        events = self._beat(ledger, "c", 1, 0.4, 100.0, gws=3, full=4)
+        assert [e["kind"] for e in events] == ["degrade"]
+        assert events[0]["group_world_size"] == 3
+        assert events[0]["full_group_world_size"] == 4
+        assert ledger.replica("c").state is HealthState.DEGRADED
+
+    def test_capacity_scaled_sample_scores_like_peers(self):
+        # a 3/4-capacity replica legitimately runs 4/3 slower; the scaled
+        # window must be indistinguishable from the healthy peers'
+        ledger = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        for step in range(1, 8):
+            now = step * 100.0
+            self._beat(ledger, "a", step, 0.3, now)
+            self._beat(ledger, "b", step, 0.3, now)
+            self._beat(ledger, "c", step, 0.4, now, gws=3, full=4)
+        window_c = list(ledger.replica("c").window)
+        assert all(s == pytest.approx(0.3) for s in window_c)
+
+    def test_degraded_never_strikes_even_when_genuinely_slow(self):
+        # eject mode, a degraded replica reporting 10x its capacity-scaled
+        # expectation: suspicious, but NEVER strike-counted while degraded
+        # (the degrade plane owns the capacity story; ejecting it would
+        # turn a survivable chip loss into a whole-replica loss)
+        ledger = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        events: List[Dict[str, Any]] = []
+        for step in range(1, 12):
+            now = step * 100.0
+            events += self._beat(ledger, "a", step, 0.1, now)
+            events += self._beat(ledger, "b", step, 0.1, now)
+            events += self._beat(ledger, "c", step, 1.0, now, gws=3, full=4)
+            events += ledger.tick(now + 50.0)
+        kinds = [e["kind"] for e in events]
+        assert "eject" not in kinds
+        rh = ledger.replica("c")
+        assert rh.state is HealthState.DEGRADED
+        assert rh.strikes == 0
+        assert ledger.exclusions == set()
+
+    def test_degraded_drains_from_serving_under_both_policies(self):
+        from torchft_tpu.healthwatch import serving_eligible
+
+        for drain_on in ("warn", "eject"):
+            assert not serving_eligible(HealthState.DEGRADED, drain_on)
+            assert not serving_eligible("degraded", drain_on)
+        # sanity: OK serves under both, WARN only under eject
+        assert serving_eligible(HealthState.OK, "warn")
+        assert serving_eligible(HealthState.WARN, "eject")
+        assert not serving_eligible(HealthState.WARN, "warn")
+
+    def test_full_capacity_beat_restores_to_ok(self):
+        ledger = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        self._beat(ledger, "c", 1, 0.4, 100.0, gws=3, full=4)
+        assert ledger.replica("c").state is HealthState.DEGRADED
+        events = self._beat(ledger, "c", 2, 0.3, 200.0, gws=4, full=4)
+        assert [e["kind"] for e in events] == ["restore"]
+        assert events[0]["group_world_size"] == 4
+        assert ledger.replica("c").state is HealthState.OK
+
+    def test_telemetry_without_capacity_keys_changes_nothing(self):
+        # the degrade-off pin at the ledger level: absent keys leave the
+        # pre-degrade scoring path untouched, bit for bit
+        plain = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        keyed = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        events_plain: List[Dict[str, Any]] = []
+        events_keyed: List[Dict[str, Any]] = []
+        for step in range(1, 10):
+            now = step * 100.0
+            for rid, step_s in (("a", 0.1), ("b", 0.1), ("slow", 1.0)):
+                events_plain += plain.on_heartbeat(
+                    rid, {"step": step, "step_s": step_s, "wire_s": 0.0}, now
+                )
+                # full == gws: full-capacity keys never scale or degrade
+                events_keyed += keyed.on_heartbeat(
+                    rid,
+                    {"step": step, "step_s": step_s, "wire_s": 0.0,
+                     "group_world_size": 4, "full_group_world_size": 4},
+                    now,
+                )
+            events_plain += plain.tick(now + 50.0)
+            events_keyed += keyed.tick(now + 50.0)
+        assert [e["kind"] for e in events_plain] == [
+            e["kind"] for e in events_keyed
+        ]
+        assert list(plain.replica("slow").window) == list(
+            keyed.replica("slow").window
+        )
+        assert plain.replica("slow").state == keyed.replica("slow").state
+
+    def test_degraded_warn_state_also_enters_degraded(self):
+        # escalation entry covers WARN too: a replica already warned keeps
+        # its window but moves under the degrade plane's protection
+        # (observe mode so sustained slowness warns without ever ejecting)
+        import dataclasses
+
+        ledger = HealthLedger(
+            dataclasses.replace(CFG, mode="observe"),
+            heartbeat_timeout_ms=5000,
+            min_replicas=1,
+        )
+        for step in range(1, 6):
+            now = step * 100.0
+            self._beat(ledger, "a", step, 0.1, now)
+            self._beat(ledger, "b", step, 0.1, now)
+            self._beat(ledger, "c", step, 0.5, now)
+            ledger.tick(now + 50.0)
+        assert ledger.replica("c").state is HealthState.WARN
+        self._beat(ledger, "c", 6, 0.4, 600.0, gws=3, full=4)
+        assert ledger.replica("c").state is HealthState.DEGRADED
+
+
 # ---------------------------------------------------------- native parity
 class TestNativeParity:
     def test_scores_match_native(self):
@@ -375,6 +501,97 @@ class TestNativeParity:
         assert rep["state"] == HealthState(rh.state).name.lower() == "ok"
         assert rep["ejections"] == rh.ejections == 1
         assert rep["readmissions"] == rh.readmissions == 1
+
+    def test_degrade_restore_replay_matches_native(self):
+        """The DEGRADED leg of the state machine through both ledgers:
+        reduced-capacity telemetry degrades, capacity-scaled samples
+        never strike, full-capacity telemetry restores — same events at
+        the same script times, same intermediate and final state."""
+        from torchft_tpu.coordination import health_replay
+
+        opts = dict(CFG.to_json(), heartbeat_timeout_ms=5000, min_replicas=1)
+
+        def entry(t, rid, step, step_s, gws=None, full=None):
+            telemetry = {"step": step, "step_s": step_s, "wire_s": 0.0}
+            if gws is not None:
+                telemetry["group_world_size"] = gws
+                telemetry["full_group_world_size"] = full
+            return {"t_ms": t, "replica_id": rid, "telemetry": telemetry}
+
+        script: List[Dict[str, Any]] = []
+        # steady fleet, then c loses a chip at step 4 and honestly runs
+        # 4/3 slower on 3/4 capacity until step 9 (capacity scaling keeps
+        # its window indistinguishable from the peers'); full capacity at
+        # step 10 restores it and the post-restore window is clean — no
+        # warn, no strike, no eject anywhere in the replay (the
+        # 10x-slow-while-degraded no-strike case is TestDegraded's)
+        for step in range(1, 12):
+            t = step * 100
+            script.append(entry(t, "a", step, 0.1))
+            script.append(entry(t, "b", step, 0.1))
+            if step < 4:
+                script.append(entry(t, "c", step, 0.1))
+            elif step < 10:
+                script.append(entry(t, "c", step, 0.4 / 3, gws=3, full=4))
+            else:
+                script.append(entry(t, "c", step, 0.1, gws=4, full=4))
+            script.append({"t_ms": t + 50, "tick": True})
+
+        native = health_replay(script, opts)
+
+        ledger = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        py_events: List[Dict[str, Any]] = []
+        degraded_seen = False
+        for e in script:
+            if e.get("tick"):
+                evs = ledger.tick(e["t_ms"])
+            else:
+                evs = ledger.on_heartbeat(
+                    e["replica_id"], e.get("telemetry"), e["t_ms"]
+                )
+            for ev in evs:
+                py_events.append(dict(ev, t_ms=e["t_ms"]))
+            rh_c = ledger.replica("c")  # None before c's first beat
+            if rh_c is not None and rh_c.state is HealthState.DEGRADED:
+                degraded_seen = True
+        assert degraded_seen
+
+        native_seq = [
+            (e["t_ms"], e["kind"], e["replica_id"]) for e in native["events"]
+        ]
+        py_seq = [(e["t_ms"], e["kind"], e["replica_id"]) for e in py_events]
+        assert native_seq == py_seq
+        kinds = [k for _, k, _ in py_seq]
+        assert kinds == ["degrade", "restore"]
+        assert "eject" not in kinds and "straggler_warn" not in kinds
+        assert native["excluded"] == sorted(ledger.exclusions) == []
+        rep = native["ledger"]["replicas"]["c"]
+        rh = ledger.replica("c")
+        assert rep["state"] == HealthState(rh.state).name.lower() == "ok"
+        assert rep["ejections"] == rh.ejections == 0
+        assert rh.strikes == 0
+
+    def test_degraded_final_state_name_matches_native(self):
+        """A replay that ENDS degraded: both sides must report the state
+        string 'degraded' and the reduced capacity in the per-replica
+        record (the serving drain and dashboards key off these)."""
+        from torchft_tpu.coordination import health_replay
+
+        opts = dict(CFG.to_json(), heartbeat_timeout_ms=5000, min_replicas=1)
+        script = [
+            {"t_ms": 100, "replica_id": "c",
+             "telemetry": {"step": 1, "step_s": 0.4, "wire_s": 0.0,
+                           "group_world_size": 3,
+                           "full_group_world_size": 4}},
+        ]
+        native = health_replay(script, opts)
+        ledger = HealthLedger(CFG, heartbeat_timeout_ms=5000, min_replicas=1)
+        ledger.on_heartbeat("c", script[0]["telemetry"], 100.0)
+        rep = native["ledger"]["replicas"]["c"]
+        rh = ledger.replica("c")
+        assert rep["state"] == HealthState(rh.state).name.lower() == "degraded"
+        assert rep["group_world_size"] == rh.group_world_size == 3
+        assert rep["full_group_world_size"] == rh.full_group_world_size == 4
 
 
 # ------------------------------------------------------ live integration

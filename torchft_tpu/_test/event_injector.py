@@ -10,7 +10,11 @@ and delayed/flaky control-plane RPCs (installed process-wide via
 ``coordination.set_rpc_fault_hook``), so the retry/failover machinery can
 be exercised deterministically. ``kill_link`` severs one data-plane ring
 link mid-collective (armed via ``ProcessGroupHost.inject_link_fault``) so
-the compressed allreduce's in-collective re-route path is what recovers. For the healthwatch plane,
+the compressed allreduce's in-collective re-route path is what recovers.
+``kill_chip`` kills one chip INSIDE a replica group (armed via
+``FakeProcessGroupWrapper.inject_group_member_death``) so the degrade-in-
+place plane — shrink TP/PP onto the survivors, stay in the quorum — is
+what recovers. For the healthwatch plane,
 ``slow_replica`` dilates the step time a replica REPORTS (installed as a
 ``Manager.set_telemetry_transform`` hook) so straggler scoring, proactive
 ejection, and probationary readmission run without real slowdowns. For the
@@ -48,6 +52,9 @@ class EventKind(Enum):
     # compressed allreduce's in-collective failover (flood, re-form, finish
     # as a re-routed slow step) is what recovers — not the step-discard path
     KILL_LINK = "kill_link"
+    # degrade plane: one chip (group_rank) inside the replica group dies —
+    # the replica shrinks TP/PP onto the survivors instead of leaving
+    KILL_CHIP = "kill_chip"
 
 
 @dataclass
@@ -190,6 +197,22 @@ class EventInjector:
             ev = dict(src=int(src), dst=int(dst), chunk=int(at_hop))
             self._events[(src, step)] = _Event(EventKind.KILL_LINK, **ev)
             self._events[(dst, step)] = _Event(EventKind.KILL_LINK, **ev)
+        return self
+
+    def kill_chip(
+        self, replica: int, group_rank: int, at_step: int
+    ) -> "EventInjector":
+        """When ``replica`` reaches ``at_step``, kill chip ``group_rank``
+        INSIDE its replica group (a within-group member death, not a whole-
+        replica failure). Fires ``inject_group_member_death`` on the
+        replica's wrapped process group, which invokes the manager's
+        registered member-death callback — under ``TORCHFT_DEGRADE=on`` the
+        replica stages a shrunken TP/PP layout and commits it at the next
+        safe point (a re-planned slow step) instead of leaving the quorum."""
+        with self._lock:
+            self._events[(replica, at_step)] = _Event(
+                EventKind.KILL_CHIP, src=int(group_rank)
+            )
         return self
 
     # --------------------------------------------------------- healthwatch
@@ -471,6 +494,14 @@ class EventInjector:
                 "(ProcessGroupHost or a wrapper around one)"
             )
             pg.inject_link_fault(src, dst, at_hop=chunk)
+        if kind == EventKind.KILL_CHIP:
+            assert pg is not None and hasattr(
+                pg, "inject_group_member_death"
+            ), (
+                "kill_chip needs a process group with "
+                "inject_group_member_death (FakeProcessGroupWrapper)"
+            )
+            pg.inject_group_member_death(src)
         if kind in (EventKind.HEAL_SOURCE_KILL, EventKind.HEAL_CHUNK_CORRUPT):
             assert transport is not None and hasattr(
                 transport, "inject_chunk_fault"

@@ -50,6 +50,10 @@ DECLARED_TIMINGS: Dict[str, str] = {
     "straggler_score": "quorum-relative modified z-score",
     "ejections": "cumulative proactive ejections of this replica",
     "readmissions": "cumulative probationary readmissions",
+    # degrade plane (in-place TP/PP shrink after an intra-group chip loss)
+    "degraded_reshard_s": "last in-place k→k-1 reshard wall clock",
+    "degrade_events": "cumulative in-place degrades of this replica",
+    "restored_events": "cumulative full-degree restores after a degrade",
     # observability honesty counters
     "dropped_events": "telemetry events shed by the bounded drain",
     "trace_dropped": "spans overwritten in the trace ring",

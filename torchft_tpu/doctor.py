@@ -21,7 +21,10 @@ with one mid-transfer connection drop injected so the ranged-resume path
 exercised, not just the happy path. The ``TORCHFT_REDUNDANCY_*`` knobs
 validate (k/m sanity plus a live-peer count against k+m when a directory
 is configured) and a loopback erasure round-trip encodes a state, corrupts
-one stored shard, and reconstructs bitwise via the parity shard.
+one stored shard, and reconstructs bitwise via the parity shard. The
+``TORCHFT_DEGRADE_*`` knobs validate and a loopback 2→1 reshard probe
+asserts the degrade plane's bitwise param-equality invariant on both
+engine paths.
 
 Exit code 0 iff every check passes (the accelerator check passes as
 "cpu-only" — a legitimate dev box). Prints one line per check:
@@ -670,6 +673,68 @@ def check_redundancy_env() -> Result:
     )
 
 
+def check_degrade_env() -> Result:
+    """``TORCHFT_DEGRADE_*`` sanity: the env contract parses into a valid
+    DegradeConfig (same validation the Manager funnels through), and a
+    loopback 2→1 reshard probe runs both engine paths — full
+    redistribution and gather-free peer-sourced — asserting the shrunken
+    layout reassembles bitwise-identical to the original params (the
+    invariant the degrade plane's correctness rests on)."""
+    try:
+        from torchft_tpu.parallel.degrade import DegradeConfig
+
+        cfg = DegradeConfig.from_env()
+    except ValueError as e:
+        return False, f"TORCHFT_DEGRADE_* invalid: {e}"
+    try:
+        import numpy as np
+
+        from torchft_tpu.parallel.degrade import (
+            assemble,
+            reshard_from_survivors,
+            reshard_full,
+        )
+
+        rng = np.random.default_rng(0)
+        full = {
+            "w": rng.standard_normal((6, 4)).astype(np.float32),
+            "b": rng.standard_normal((3,)).astype(np.float32),
+        }
+        axes = {"w": 0, "b": None}
+        two_chip, _ = reshard_full(full, axes, 2)
+        # full path: 2 -> 1
+        one_chip, _ = reshard_full(full, axes, 1)
+        back = assemble(one_chip, axes)
+        if not all(
+            np.array_equal(back[k], full[k]) for k in full
+        ):
+            return False, "full-path 2->1 reshard probe not bitwise equal"
+        # peer path: kill rank 1, source its shard from the old layout
+        dead_shards = {"w": np.asarray(two_chip[1]["w"])}
+        survivors, _ = reshard_from_survivors(
+            [two_chip[0], None],
+            dead_rank=1,
+            axes=axes,
+            shard_source=lambda path: dead_shards["w"],
+        )
+        back = assemble(survivors, axes)
+        if not all(
+            np.array_equal(back[k], full[k]) for k in full
+        ):
+            return False, "peer-path 2->1 reshard probe not bitwise equal"
+    except Exception as e:  # noqa: BLE001
+        return False, f"degrade reshard probe failed: {e}"
+    if not cfg.enabled:
+        return True, (
+            "degrade plane off (TORCHFT_DEGRADE=off — chip loss costs the "
+            "whole replica); reshard probe bitwise ok"
+        )
+    return True, (
+        f"on: min_degree={cfg.min_degree} restore={cfg.restore}; "
+        "2->1 reshard probe bitwise ok (full + peer paths)"
+    )
+
+
 def check_redundancy_roundtrip() -> Result:
     """Loopback redundancy probe: encode a state across k=2/m=1 shards on
     three stores, corrupt one data shard's stored bytes, and reconstruct —
@@ -825,6 +890,7 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("compress-env", check_compress_env),
     ("serve-env", check_serve_env),
     ("redundancy-env", check_redundancy_env),
+    ("degrade-env", check_degrade_env),
     ("trace-env", check_trace_env),
     ("tuning-env", check_tuning_env),
     ("fleetlint", check_fleetlint),

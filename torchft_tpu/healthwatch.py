@@ -17,6 +17,12 @@ step-granular membership decisions:
    (:class:`HealthLedger`); an ejected replica enters the exclusion set the
    quorum computation consults, so ejection is just a step-granular
    membership change through the existing shrink path.
+4. A replica that lost a chip and reshard onto its survivors
+   (docs/operations.md#degraded-replicas) self-reports its reduced
+   ``group_world_size`` in telemetry and enters ``DEGRADED``: its compute
+   samples are capacity-scaled so the straggler statistics stay honest, it
+   never accrues eject strikes, it drains from serving rotation, and it
+   re-promotes to OK the moment full degree is reported again.
 
 This module is the **canonical spec**: the native ledger
 (native/healthwatch.cc) mirrors the math and state machine here, and
@@ -222,6 +228,12 @@ class HealthState(IntEnum):
     WARN = 1
     EJECTED = 2
     PROBATION = 3
+    # Escalation-wise DEGRADED sits between OK and WARN — slower than OK by
+    # design, but never suspicious: the replica told us it lost a chip and
+    # is running at reduced group degree (docs/operations.md#degraded-replicas).
+    # The code is appended (not renumbered) because 0..3 are pinned by the
+    # native ledger parity, timings() health_state, and /metrics docs.
+    DEGRADED = 4
 
 
 # Serving-plane drain policy (docs/serving.md): which health states pull a
@@ -230,10 +242,17 @@ class HealthState(IntEnum):
 # removes the replica from training, so inference traffic never routes to
 # a replica the ledger is already suspicious of.  ``"eject"`` only drains
 # replicas the ledger has actually ejected (lenient; more serving capacity
-# at the cost of routing to stragglers).
+# at the cost of routing to stragglers).  DEGRADED drains under BOTH
+# policies: a degraded replica is resharding / running at reduced degree,
+# so its spare cycles belong to training catch-up, not inference.
 SERVE_DRAIN_STATES: Dict[str, Tuple[HealthState, ...]] = {
-    "warn": (HealthState.WARN, HealthState.EJECTED, HealthState.PROBATION),
-    "eject": (HealthState.EJECTED,),
+    "warn": (
+        HealthState.WARN,
+        HealthState.EJECTED,
+        HealthState.PROBATION,
+        HealthState.DEGRADED,
+    ),
+    "eject": (HealthState.EJECTED, HealthState.DEGRADED),
 }
 
 _STATE_NAMES = {
@@ -241,6 +260,7 @@ _STATE_NAMES = {
     "warn": HealthState.WARN,
     "ejected": HealthState.EJECTED,
     "probation": HealthState.PROBATION,
+    "degraded": HealthState.DEGRADED,
 }
 
 
@@ -306,6 +326,9 @@ class _Replica:
     samples_total: int = 0
     ejected_at_ms: float = 0.0
     last_beat_ms: Optional[float] = None
+    # degrade plane: last reported group degree (0 = never reported)
+    group_world_size: int = 0
+    full_group_world_size: int = 0
 
 
 class HealthLedger:
@@ -363,7 +386,47 @@ class HealthLedger:
                 wire_s = float(telemetry.get("wire_s", 0.0))
                 rh.last_step_s = step_s
                 rh.last_wire_s = wire_s
-                rh.window.append(max(step_s - wire_s, 0.0))
+                sample = max(step_s - wire_s, 0.0)
+                # Degrade plane: a replica running at reduced group degree
+                # self-reports its capacity; its compute sample is scaled to
+                # the full-capacity equivalent so it is scored against what
+                # it SHOULD cost, never strike-ejected for being
+                # legitimately slower.  Beats without both keys take the
+                # exact pre-degrade path.
+                gws = telemetry.get("group_world_size")
+                full = telemetry.get("full_group_world_size")
+                if gws is not None and full is not None:
+                    gws = int(gws)
+                    full = int(full)
+                    rh.group_world_size = gws
+                    rh.full_group_world_size = full
+                    if 0 < gws < full:
+                        sample *= gws / float(full)
+                        if rh.state in (HealthState.OK, HealthState.WARN):
+                            rh.state = HealthState.DEGRADED
+                            rh.strikes = 0
+                            events.append(
+                                {
+                                    "kind": "degrade",
+                                    "replica_id": replica_id,
+                                    "group_world_size": gws,
+                                    "full_group_world_size": full,
+                                }
+                            )
+                    elif (
+                        rh.state is HealthState.DEGRADED
+                        and full > 0
+                        and gws >= full
+                    ):
+                        rh.state = HealthState.OK
+                        events.append(
+                            {
+                                "kind": "restore",
+                                "replica_id": replica_id,
+                                "group_world_size": gws,
+                            }
+                        )
+                rh.window.append(sample)
                 del rh.window[: -self.config.window]
                 rh.samples_total += 1
                 self._evaluate(replica_id, now_ms, events)
@@ -461,6 +524,14 @@ class HealthLedger:
 
         rh = self._replicas[rid]
         s = rh.score
+
+        if rh.state is HealthState.DEGRADED:
+            # Capacity-scaled samples keep the peer statistics honest, but
+            # a degraded replica never accumulates strikes and never warns:
+            # it is slow-but-alive by declaration, and ejecting it would
+            # turn a survivable chip loss into a whole-group loss.
+            rh.strikes = 0
+            return
 
         if rh.state is HealthState.PROBATION:
             if s > cfg.eject_z:  # one strike in probation: straight back out

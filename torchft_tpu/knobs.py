@@ -180,6 +180,19 @@ REGISTRY: Dict[str, Knob] = {
            "redundancy-env", "Shard generations retained per owner in each store."),
         _k("TORCHFT_POD", "str", "", "operations.md#running-a-fleet", "tuning-env",
            "Placement pod identity (defaults to the aggregator-derived pod)."),
+        # ---------------------------------------------------- degrade plane
+        _k("TORCHFT_DEGRADE", "enum(off|on)", "off",
+           "operations.md#degraded-replicas", "degrade-env",
+           "Degrade-in-place: shrink TP/PP onto surviving chips instead of"
+           " leaving the quorum when a group member dies."),
+        _k("TORCHFT_DEGRADE_MIN_DEGREE", "int", "1",
+           "operations.md#degraded-replicas", "degrade-env",
+           "Smallest surviving group degree worth resharding onto; below it"
+           " the replica falls back to the classic leave-heal-rejoin path."),
+        _k("TORCHFT_DEGRADE_RESTORE", "enum(auto|manual)", "auto",
+           "operations.md#degraded-replicas", "degrade-env",
+           "Restore policy: auto re-promotes when a repaired chip reports in;"
+           " manual waits for an operator restore_full_degree() call."),
         # -------------------------------------------------- device plane
         _k("TORCHFT_XLA_HEARTBEAT_SEC", "float", "10", "api.md#process-groups", "tuning-env",
            "XLA process-group peer heartbeat timeout."),

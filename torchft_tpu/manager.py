@@ -135,6 +135,10 @@ _COUNTER_TIMINGS = frozenset(
         "reconstruct_failures",
         "shard_corrupt",
         "shard_fetch_failed",
+        # degrade plane (parallel/degrade.py): in-place group shrinks and
+        # full-degree restores
+        "degrade_events",
+        "restored_events",
     }
 )
 
@@ -506,6 +510,10 @@ class Manager:
         # flight-recorder breadcrumbs (_publish_step_telemetry).
         for _counter in ("health_state", "straggler_score", "ejections", "readmissions"):
             self._timings[_counter] = 0.0
+        # degrade plane: in-place group shrinks / full-degree restores
+        # (docs/operations.md#degraded-replicas)
+        for _counter in ("degrade_events", "restored_events"):
+            self._timings[_counter] = 0.0
         self._telemetry_transform: Optional[
             Callable[[Dict[str, Any]], Dict[str, Any]]
         ] = None
@@ -645,6 +653,42 @@ class Manager:
             self._redundancy_cfg = None
             self._shard_stager = None
 
+        # degrade plane (parallel/degrade.py, docs/operations.md
+        # #degraded-replicas): with TORCHFT_DEGRADE=on a dead chip inside
+        # the replica group shrinks the group's own TP/PP degree in place
+        # — a re-planned slow step — instead of costing the whole group a
+        # leave-heal-rejoin cycle. Off (the default) registers nothing and
+        # leaves every code path byte-identical, pinned by
+        # tests/test_degrade.py.
+        self._degrade_cfg: Optional[Any] = None
+        self._degrade_lock = threading.Lock()
+        # the group's parallel degree: in single-controller SPMD jobs the
+        # mesh spans chips the Manager's group_world_size never sees, so
+        # the degree is declared via set_group_degree()
+        self._full_group_degree: int = group_world_size
+        self._group_degree: int = group_world_size
+        self._degrade_pending: Optional[int] = None  # dead group_rank
+        self._reshard_fn: Optional[Callable[[int, int], Any]] = None
+        try:
+            from torchft_tpu.parallel.degrade import DegradeConfig
+
+            _deg_cfg = DegradeConfig.from_env()
+            if _deg_cfg.enabled:
+                self._degrade_cfg = _deg_cfg
+                # member-death detection: the abort watchdog / fault
+                # injection path on PGs that track intra-group members.
+                # Registered ONLY when the plane is on.
+                _set_death = getattr(pg, "set_member_death_callback", None)
+                if _set_death is not None:
+                    _set_death(self.report_member_death)
+        except ValueError:
+            raise
+        except Exception:  # noqa: BLE001 — the plane is advisory
+            self._logger.exception(
+                "degrade plane failed to attach; continuing without it"
+            )
+            self._degrade_cfg = None
+
     # ------------------------------------------------------------- state fns
     def register_state_dict_fn(
         self,
@@ -725,6 +769,15 @@ class Manager:
         self._errored = None
         self._healing = False
         self._last_quorum_healed = False
+
+        # a degrade staged since the last safe point lands here, AFTER the
+        # per-step error reset and BEFORE the new prepare is submitted: the
+        # reshard must replace the dead member before the next quorum's
+        # world is staged, and a fallback's report_error must survive into
+        # this step so its vote fails (placing this above the reset
+        # silently swallowed the fallback)
+        if self._degrade_cfg is not None:
+            self._commit_pending_degrade()
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -1261,6 +1314,178 @@ class Manager:
         finally:
             self._record_timing("configure_commit_s", time.perf_counter() - t0)
             self._log_timing_snapshot("configure_commit")
+
+    # -------------------------------------------------------- degrade plane
+    def set_group_degree(self, full_degree: int) -> None:
+        """Declare the group's intra-replica parallel degree (chips in its
+        TP/PP mesh). Single-controller SPMD jobs own chips the Manager's
+        ``group_world_size`` never sees, so the degrade plane scores and
+        reports against this declared degree. Resets any in-progress
+        degrade bookkeeping to full capacity."""
+        if full_degree < 1:
+            raise ValueError(f"full_degree must be >= 1, got {full_degree}")
+        with self._degrade_lock:
+            self._full_group_degree = full_degree
+            self._group_degree = full_degree
+            self._degrade_pending = None
+
+    def set_reshard_fn(
+        self, fn: Optional[Callable[[int, int], Any]]
+    ) -> None:
+        """Register the trainer's reshard hook, called at the commit point
+        of a staged degrade as ``fn(dead_group_rank, new_degree)``. The
+        hook owns the actual param movement (parallel/degrade.py reshard +
+        mesh.shrink_mesh device_put); the Manager stays model-agnostic. A
+        raise inside the hook falls back to the classic whole-group error
+        path. May return a stats dict (e.g. DegradeStats.to_json()) that
+        rides the flight-recorder breadcrumb."""
+        self._reshard_fn = fn
+
+    @property
+    def group_degree(self) -> int:
+        """Current intra-group parallel degree (< full while degraded)."""
+        return self._group_degree
+
+    @property
+    def full_group_degree(self) -> int:
+        return self._full_group_degree
+
+    def report_member_death(self, group_rank: int) -> None:
+        """Stage a degrade: chip ``group_rank`` of this group's mesh died.
+        Called by the PG's abort watchdog / fault injection (via
+        ``set_member_death_callback``) or directly by a trainer that
+        detected the loss. Thread-safe; the shrink itself is applied at
+        the next safe point (_commit_pending_degrade), making the step a
+        re-planned slow step rather than a discarded one."""
+        if self._degrade_cfg is None:
+            return
+        with self._degrade_lock:
+            if self._degrade_pending is not None:
+                return  # one shrink at a time; next death re-stages after
+            self._degrade_pending = int(group_rank)
+        self._logger.warning(
+            f"group member {group_rank} died; degrade staged "
+            f"(degree {self._group_degree} -> {self._group_degree - 1})"
+        )
+
+    def _commit_pending_degrade(self) -> None:
+        """Apply a staged intra-group degrade at a safe point (main
+        thread, same sync points as _commit_pending_configure). Shrinks
+        the declared group degree, runs the registered reshard hook, and
+        surfaces the event; if the surviving degree would fall below
+        min_degree or the reshard fails, falls back to the classic
+        whole-group error path (report_error -> this step's vote is False
+        and the group leaves to heal)."""
+        if self._degrade_cfg is None:
+            return
+        with self._degrade_lock:
+            dead_rank, self._degrade_pending = self._degrade_pending, None
+            degree = self._group_degree
+            full = self._full_group_degree
+        if dead_rank is None:
+            return
+        new_degree = degree - 1
+        if new_degree < self._degrade_cfg.min_degree:
+            self.report_error(
+                RuntimeError(
+                    f"group member {dead_rank} died and surviving degree "
+                    f"{new_degree} is below TORCHFT_DEGRADE_MIN_DEGREE="
+                    f"{self._degrade_cfg.min_degree}; falling back to "
+                    "leave-heal-rejoin"
+                )
+            )
+            return
+        t0 = time.perf_counter()
+        stats: Any = None
+        try:
+            with self._tracer.span(
+                "degraded_reshard", cat="degrade", dead_rank=dead_rank
+            ):
+                if self._reshard_fn is not None:
+                    stats = self._reshard_fn(dead_rank, new_degree)
+                shrink = getattr(self._pg, "prepare_shrink", None)
+                if shrink is not None:
+                    commit = shrink(dead_rank)
+                    if commit is not None:
+                        commit()  # already at a safe point
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(
+                f"in-place reshard after member {dead_rank} death failed; "
+                "falling back to leave-heal-rejoin"
+            )
+            self.report_error(e)
+            return
+        reshard_s = time.perf_counter() - t0
+        with self._degrade_lock:
+            self._group_degree = new_degree
+        self._record_timing("degraded_reshard_s", reshard_s)
+        self._bump_counter("degrade_events")
+        self._logger.warning(
+            f"degraded in place: member {dead_rank} lost, group degree "
+            f"{degree} -> {new_degree} (full {full}), reshard took "
+            f"{reshard_s:.3f}s"
+        )
+        emit_event_async(
+            HEALTH_EVENTS,
+            replica_id=self._replica_id,
+            group_rank=self._group_rank,
+            step=self._step,
+            quorum_id=self._quorum_id,
+            kind="degrade",
+            dead_group_rank=dead_rank,
+            group_world_size=new_degree,
+            full_group_world_size=full,
+            reshard_s=reshard_s,
+        )
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            "degrade",
+            dead_group_rank=dead_rank,
+            group_world_size=new_degree,
+            full_group_world_size=full,
+            reshard_s=reshard_s,
+            stats=stats,
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+        )
+
+    def restore_full_degree(self) -> None:
+        """Re-promote a degraded group to full degree (a spare/repaired
+        chip came back). Telemetry returns to full capacity on the next
+        beat, which walks the lighthouse ledger DEGRADED -> OK."""
+        if self._degrade_cfg is None:
+            return
+        with self._degrade_lock:
+            restored = self._group_degree < self._full_group_degree
+            degree = self._full_group_degree
+            self._group_degree = degree
+            self._degrade_pending = None
+        if not restored:
+            return
+        self._bump_counter("restored_events")
+        self._logger.warning(
+            f"restored to full group degree {degree}"
+        )
+        emit_event_async(
+            HEALTH_EVENTS,
+            replica_id=self._replica_id,
+            group_rank=self._group_rank,
+            step=self._step,
+            quorum_id=self._quorum_id,
+            kind="restore",
+            group_world_size=degree,
+        )
+        from torchft_tpu.flight_recorder import recorder
+
+        recorder.record(
+            "restore",
+            group_world_size=degree,
+            step=self._step,
+            replica=self._replica_id,
+            group_rank=self._group_rank,
+        )
 
     # ------------------------------------------------------------ allreduce
     def allreduce(
@@ -2596,6 +2821,15 @@ class Manager:
                     "heal_attempts": heal_attempts,
                     "rpc_retries": rpc_retries,
                 }
+                if self._degrade_cfg is not None:
+                    # degrade plane: self-report capacity so the ledger
+                    # scores this replica against what a step SHOULD cost
+                    # at its current degree (healthwatch DEGRADED state)
+                    with self._degrade_lock:
+                        telemetry["group_world_size"] = self._group_degree
+                        telemetry["full_group_world_size"] = (
+                            self._full_group_degree
+                        )
                 if self._telemetry_transform is not None:
                     telemetry = self._telemetry_transform(telemetry)
                 self._manager.publish_telemetry(telemetry)
@@ -2626,6 +2860,8 @@ class Manager:
             "ejected": "eject",
             "probation": "readmit",
             "ok": "recovered",
+            # ledger acknowledged this replica's reduced group degree
+            "degraded": "degrade_acked",
         }.get(state, state)
         emit_event_async(
             HEALTH_EVENTS,
@@ -2789,6 +3025,11 @@ class Manager:
         # that triggered the change); the sync flow cleared that state
         # inside configure, the split flow clears it at commit
         self._commit_pending_configure()
+        # a staged intra-group degrade also lands here, BEFORE the errored
+        # sample: the reshard replaces the dead member, so the step votes
+        # as a re-planned slow step instead of a discarded one
+        if self._degrade_cfg is not None:
+            self._commit_pending_degrade()
 
         if (err := self._pg.errored()) is not None:
             self.report_error(err)

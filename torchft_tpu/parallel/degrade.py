@@ -1,0 +1,323 @@
+"""Degrade-in-place reshard engine: remap a sharded param tree from a
+k-chip mesh onto a (k-1)-chip mesh, bitwise.
+
+The repo's fault model (PAPER.md) was *across* replica groups only: one
+dead chip cost its whole group — leave the quorum, heal, rejoin. This
+module is the data-plane half of the degrade plane
+(docs/operations.md#degraded-replicas): when a group member dies the
+survivors reshard the param tree onto themselves and the group stays in
+the quorum as a slower member.
+
+Two reshard paths, both bitwise-equal to the pre-fault params:
+
+- :func:`reshard_from_survivors` — **gather-free**: survivors keep their
+  shards, only the dead rank's shard is sourced from outside the group
+  (the erasure/heal transport of the redundancy plane — peer-staged
+  shards, ``checkpointing/transport.py``) via the ``shard_source``
+  callback, then the k shards are re-split onto k-1 chips. Replicated
+  leaves never move at all.
+- :func:`reshard_full` — **full intra-group redistribution**: when no
+  peer can source the lost shard, rebuild every leaf's (k-1)-way split
+  from the host-side full copy (the Manager's user ``state_dict()``,
+  which survives chip loss by construction).
+
+Splitting uses ``np.array_split`` semantics (the first ``n % d`` shards
+take one extra row), so reassembly is plain concatenation and
+``concatenate(split(x)) == x`` holds bitwise for any degree — the
+invariant :func:`assemble` verifies and tests/doctor pin.
+
+The engine is numpy-level on purpose: it runs identically on the host
+plane (doctor probes, CPU tests) and under a real mesh, where the caller
+device_puts the returned per-chip trees onto the shrunken mesh
+(:func:`torchft_tpu.parallel.mesh.shrink_mesh`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "DegradeConfig",
+    "DegradeError",
+    "DegradeStats",
+    "axes_from_specs",
+    "split_even",
+    "assemble",
+    "reshard_full",
+    "reshard_from_survivors",
+]
+
+_RESTORE_POLICIES = ("auto", "manual")
+
+
+class DegradeError(RuntimeError):
+    """A reshard could not be completed (missing shard, shape mismatch)."""
+
+
+@dataclass(frozen=True)
+class DegradeConfig:
+    """Degrade-plane policy knobs (``TORCHFT_DEGRADE_*``).
+
+    ``enabled`` gates the whole plane: off (the default) leaves every
+    Manager/PG code path byte-identical to pre-degrade behavior (pinned
+    by tests). ``min_degree`` is the smallest surviving group degree
+    worth resharding onto — below it a chip loss falls back to the
+    classic leave-heal-rejoin path. ``restore`` picks who re-promotes a
+    degraded group: ``auto`` (a repaired chip reporting in restores full
+    degree) or ``manual`` (an operator restore_full_degree() call).
+    """
+
+    enabled: bool = False
+    min_degree: int = 1
+    restore: str = "auto"
+
+    @staticmethod
+    def from_env() -> "DegradeConfig":
+        """Build from ``TORCHFT_DEGRADE_*``; raises ValueError on junk."""
+        from torchft_tpu import knobs
+
+        raw = knobs.env_raw("TORCHFT_DEGRADE")
+        mode = (raw or "off").strip().lower() or "off"
+        if mode not in ("off", "on"):
+            raise ValueError(
+                f"TORCHFT_DEGRADE={raw!r}: must be 'off' or 'on'"
+            )
+        raw_min = knobs.env_raw("TORCHFT_DEGRADE_MIN_DEGREE")
+        try:
+            min_degree = int(raw_min) if raw_min not in (None, "") else 1
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"TORCHFT_DEGRADE_MIN_DEGREE={raw_min!r}: {e}"
+            ) from e
+        raw_restore = knobs.env_raw("TORCHFT_DEGRADE_RESTORE")
+        restore = (raw_restore or "auto").strip().lower() or "auto"
+        cfg = DegradeConfig(
+            enabled=(mode == "on"), min_degree=min_degree, restore=restore
+        )
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.min_degree < 1:
+            raise ValueError(
+                f"min_degree must be >= 1, got {self.min_degree}"
+            )
+        if self.restore not in _RESTORE_POLICIES:
+            raise ValueError(
+                f"TORCHFT_DEGRADE_RESTORE={self.restore!r}: must be one of"
+                f" {_RESTORE_POLICIES}"
+            )
+
+
+@dataclass
+class DegradeStats:
+    """What a reshard cost; surfaced via Manager timings/breadcrumbs."""
+
+    mode: str = ""  # "peer" (gather-free) | "full" (redistribution)
+    leaves_total: int = 0
+    leaves_sharded: int = 0
+    leaves_replicated: int = 0
+    bytes_sourced: int = 0  # fetched from outside the group (dead shard)
+    bytes_moved: int = 0  # re-split bytes placed onto survivors
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode,
+            "leaves_total": self.leaves_total,
+            "leaves_sharded": self.leaves_sharded,
+            "leaves_replicated": self.leaves_replicated,
+            "bytes_sourced": self.bytes_sourced,
+            "bytes_moved": self.bytes_moved,
+        }
+
+
+def _tree_parts(tree: Any, none_is_leaf: bool = False):
+    import jax
+
+    # An axes tree carries None for replicated leaves; None is normally an
+    # EMPTY pytree node and would silently drop out of the flatten,
+    # misaligning axes against params — flag it as a leaf there.
+    kwargs = {"is_leaf": (lambda x: x is None)} if none_is_leaf else {}
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        tree, **kwargs
+    )
+    paths = [jax.tree_util.keystr(p) for p, _ in leaves_with_paths]
+    leaves = [leaf for _, leaf in leaves_with_paths]
+    return paths, leaves, treedef
+
+
+def axes_from_specs(specs: Any, axis_name: str) -> Any:
+    """Map a PartitionSpec tree to per-leaf reshard axes for ``axis_name``.
+
+    Each leaf becomes the tensor dim index whose spec entry mentions
+    ``axis_name`` (entries may be a name or a tuple of names), or None if
+    the leaf is replicated over that axis. This is how mesh.py's TP specs
+    and pipeline.py's pp specs project onto the degrade engine.
+    """
+    import jax
+
+    def _axis(spec: Any) -> Optional[int]:
+        if spec is None:
+            return None
+        for dim, entry in enumerate(tuple(spec)):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if axis_name in names:
+                return dim
+        return None
+
+    return jax.tree_util.tree_map(
+        _axis,
+        specs,
+        is_leaf=lambda x: x is None or not isinstance(x, dict),
+    )
+
+
+def split_even(arr: np.ndarray, degree: int, axis: int) -> List[np.ndarray]:
+    """Split ``arr`` into ``degree`` contiguous chunks along ``axis``
+    (np.array_split semantics: the first ``n % degree`` chunks get one
+    extra row). Concatenating the result reproduces ``arr`` bitwise."""
+    if degree < 1:
+        raise DegradeError(f"split degree must be >= 1, got {degree}")
+    a = np.asarray(arr)
+    if a.ndim <= axis:
+        raise DegradeError(
+            f"cannot split a rank-{a.ndim} array along axis {axis}"
+        )
+    return [np.ascontiguousarray(s) for s in np.array_split(a, degree, axis)]
+
+
+def assemble(shard_trees: Sequence[Any], axes: Any) -> Any:
+    """Inverse of a reshard: concatenate per-chip trees back into the full
+    tree (replicated leaves take chip 0's copy). Used by tests and the
+    doctor probe to assert bitwise equality across a degrade."""
+    import jax
+
+    if not shard_trees:
+        raise DegradeError("assemble needs at least one shard tree")
+
+    def _join(axis: Optional[int], *leaves: Any) -> np.ndarray:
+        arrs = [np.asarray(x) for x in leaves]
+        if axis is None:
+            return arrs[0]
+        return np.concatenate(arrs, axis=axis)
+
+    paths, axis_leaves, treedef = _tree_parts(axes, none_is_leaf=True)
+    per_tree_leaves = [_tree_parts(t)[1] for t in shard_trees]
+    out = [
+        _join(axis_leaves[i], *[tl[i] for tl in per_tree_leaves])
+        for i in range(len(axis_leaves))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def reshard_full(
+    full_tree: Any, axes: Any, new_degree: int
+) -> "tuple[List[Any], DegradeStats]":
+    """Full intra-group redistribution: split the host-side full params
+    onto ``new_degree`` chips. Returns (per-chip trees, stats)."""
+    import jax
+
+    stats = DegradeStats(mode="full")
+    paths, leaves, treedef = _tree_parts(full_tree)
+    _, axis_leaves, _ = _tree_parts(axes, none_is_leaf=True)
+    if len(leaves) != len(axis_leaves):
+        raise DegradeError(
+            f"axes tree has {len(axis_leaves)} leaves, params have "
+            f"{len(leaves)}"
+        )
+    per_chip: List[List[np.ndarray]] = [[] for _ in range(new_degree)]
+    for leaf, axis in zip(leaves, axis_leaves):
+        a = np.asarray(leaf)
+        stats.leaves_total += 1
+        if axis is None:
+            stats.leaves_replicated += 1
+            for c in range(new_degree):
+                per_chip[c].append(a)
+            continue
+        stats.leaves_sharded += 1
+        shards = split_even(a, new_degree, axis)
+        stats.bytes_moved += a.nbytes
+        for c in range(new_degree):
+            per_chip[c].append(shards[c])
+    trees = [
+        jax.tree_util.tree_unflatten(treedef, chip) for chip in per_chip
+    ]
+    return trees, stats
+
+
+def reshard_from_survivors(
+    rank_trees: Sequence[Any],
+    dead_rank: int,
+    axes: Any,
+    shard_source: Optional[Callable[[str], np.ndarray]] = None,
+) -> "tuple[List[Any], DegradeStats]":
+    """Gather-free reshard: survivors contribute their shards in place;
+    the dead rank's shard of each sharded leaf is sourced from a peer via
+    ``shard_source(leaf_path) -> np.ndarray`` (the erasure/heal transport
+    of the redundancy plane). Replicated leaves come straight from any
+    survivor and never move.
+
+    ``rank_trees[dead_rank]`` is ignored (typically None — the chip is
+    gone). Returns (per-chip trees for the k-1 survivors, stats). Raises
+    :class:`DegradeError` if a sharded leaf's lost shard cannot be
+    sourced — callers fall back to :func:`reshard_full`.
+    """
+    import jax
+
+    k = len(rank_trees)
+    if not (0 <= dead_rank < k):
+        raise DegradeError(f"dead_rank {dead_rank} out of range for k={k}")
+    if k < 2:
+        raise DegradeError("cannot shrink a 1-chip group")
+    stats = DegradeStats(mode="peer")
+    survivors = [r for r in range(k) if r != dead_rank]
+    parts = [
+        _tree_parts(rank_trees[r]) for r in survivors
+    ]  # (paths, leaves, treedef) per survivor
+    paths, _, treedef = parts[0]
+    _, axis_leaves, _ = _tree_parts(axes, none_is_leaf=True)
+    if len(axis_leaves) != len(paths):
+        raise DegradeError(
+            f"axes tree has {len(axis_leaves)} leaves, params have "
+            f"{len(paths)}"
+        )
+    new_degree = k - 1
+    per_chip: List[List[np.ndarray]] = [[] for _ in range(new_degree)]
+    for i, (path, axis) in enumerate(zip(paths, axis_leaves)):
+        stats.leaves_total += 1
+        if axis is None:
+            stats.leaves_replicated += 1
+            a = np.asarray(parts[0][1][i])
+            for c in range(new_degree):
+                per_chip[c].append(a)
+            continue
+        stats.leaves_sharded += 1
+        if shard_source is None:
+            raise DegradeError(
+                f"leaf {path} is sharded and rank {dead_rank}'s shard is "
+                "lost: no shard_source to fetch it from a peer"
+            )
+        lost = np.asarray(shard_source(path))
+        stats.bytes_sourced += lost.nbytes
+        # reassemble in rank order, then re-split onto the survivors
+        by_rank: List[np.ndarray] = []
+        s_iter = iter(range(len(survivors)))
+        for r in range(k):
+            if r == dead_rank:
+                by_rank.append(lost)
+            else:
+                by_rank.append(np.asarray(parts[next(s_iter)][1][i]))
+        full = np.concatenate(by_rank, axis=axis)
+        shards = split_even(full, new_degree, axis)
+        stats.bytes_moved += full.nbytes
+        for c in range(new_degree):
+            per_chip[c].append(shards[c])
+    trees = [
+        jax.tree_util.tree_unflatten(treedef, chip) for chip in per_chip
+    ]
+    return trees, stats

@@ -32,7 +32,9 @@ from torchft_tpu.models.llama import LlamaConfig
 
 __all__ = [
     "make_hsdp_mesh",
+    "shrink_mesh",
     "llama_param_specs",
+    "degrade_axes",
     "shard_params",
     "batch_sharding",
     "make_train_step",
@@ -51,6 +53,30 @@ def make_hsdp_mesh(
     assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
     arr = np.asarray(devices[:n]).reshape(dp, fsdp, ep, sp, tp)
     return Mesh(arr, ("dp", "fsdp", "ep", "sp", "tp"))
+
+
+def shrink_mesh(mesh: Mesh, axis_name: str, dead_index: int) -> Mesh:
+    """Degrade-in-place hook: the same mesh minus one slice of ``axis_name``
+    (the slice holding the dead chip). Axis order and the other axis sizes
+    are preserved, so existing PartitionSpecs stay valid — only the named
+    axis's degree drops by one. Param movement onto the shrunken mesh is
+    the reshard engine's job (torchft_tpu/parallel/degrade.py)."""
+    names = mesh.axis_names
+    if axis_name not in names:
+        raise ValueError(f"mesh has no axis {axis_name!r} (axes: {names})")
+    axis = names.index(axis_name)
+    devs = np.asarray(mesh.devices)
+    if devs.shape[axis] < 2:
+        raise ValueError(
+            f"axis {axis_name!r} has degree {devs.shape[axis]}; nothing to"
+            " shrink onto"
+        )
+    if not 0 <= dead_index < devs.shape[axis]:
+        raise ValueError(
+            f"dead_index {dead_index} out of range for axis {axis_name!r}"
+            f" of degree {devs.shape[axis]}"
+        )
+    return Mesh(np.delete(devs, dead_index, axis=axis), names)
 
 
 def llama_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
@@ -77,6 +103,15 @@ def llama_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
         "final_norm": P(None),
         "lm_head": P("fsdp", "tp"),
     }
+
+
+def degrade_axes(cfg: LlamaConfig, axis_name: str = "tp") -> Dict[str, Any]:
+    """Per-leaf reshard axes for shrinking ``axis_name`` in place: the
+    llama HSDP specs projected through the degrade engine
+    (torchft_tpu/parallel/degrade.py axes_from_specs)."""
+    from torchft_tpu.parallel.degrade import axes_from_specs
+
+    return axes_from_specs(llama_param_specs(cfg), axis_name)
 
 
 def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:

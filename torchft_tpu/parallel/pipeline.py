@@ -29,7 +29,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "make_pp_llama_loss", "pp_param_specs"]
+__all__ = [
+    "pipeline_apply",
+    "make_pp_llama_loss",
+    "pp_param_specs",
+    "pp_degrade_axes",
+]
 
 
 def pipeline_apply(
@@ -106,6 +111,20 @@ def pp_param_specs(cfg: Any) -> Any:
         "final_norm": P(None),
         "lm_head": P(None, None),
     }
+
+
+def pp_degrade_axes(cfg: Any) -> Any:
+    """Degrade-in-place hook: per-leaf reshard axes for shrinking the
+    pipeline by one stage. Layer stacks are sharded over ``pp`` on dim 0,
+    so losing a stage is a dim-0 reshard of every ``layers`` leaf: each of
+    the P-1 survivors picks up a slightly deeper local sub-stack
+    (np.array_split semantics), and the scanned sub-stacks still
+    concatenate to the identical full model — the bubble count just grows
+    by the shrunken P. Feed this to degrade.reshard_from_survivors /
+    reshard_full."""
+    from torchft_tpu.parallel.degrade import axes_from_specs
+
+    return axes_from_specs(pp_param_specs(cfg), "pp")
 
 
 def make_pp_llama_loss(cfg: Any, mesh: Mesh, num_microbatches: Optional[int] = None,

@@ -2287,6 +2287,11 @@ class FakeProcessGroupWrapper(ProcessGroup):
         # quorum thread) — EventInjector uses it to stall the prepare
         # phase past a step boundary deterministically
         self._on_prepare: Optional[Callable[[], None]] = None
+        # intra-group member death (degrade plane): the Manager registers
+        # a callback here when TORCHFT_DEGRADE=on; dead members accumulate
+        # so a test can assert which chips a scenario lost
+        self._member_death_cb: Optional[Callable[[int], None]] = None
+        self._dead_members: List[int] = []
 
     @property
     def device_native(self) -> bool:
@@ -2316,6 +2321,39 @@ class FakeProcessGroupWrapper(ProcessGroup):
 
     def set_prepare_hook(self, fn: Optional[Callable[[], None]]) -> None:
         self._on_prepare = fn
+
+    # -- intra-group member death (degrade plane) -------------------------
+    def set_member_death_callback(
+        self, fn: Optional[Callable[[int], None]]
+    ) -> None:
+        """Degrade-plane detection hook: the Manager registers its
+        report_member_death here (only when TORCHFT_DEGRADE=on), matching
+        the abort-watchdog shape a device PG would use on real hardware.
+        Also forwarded to the wrapped PG when it has its own support."""
+        self._member_death_cb = fn
+        setter = getattr(self._pg, "set_member_death_callback", None)
+        if setter is not None:
+            setter(fn)
+
+    def inject_group_member_death(self, group_rank: int) -> None:
+        """Kill chip ``group_rank`` INSIDE this replica's group: the
+        intra-group fault the degrade plane survives by resharding onto
+        the survivors (EventInjector.kill_chip routes here). Fires the
+        registered member-death callback between steps — the
+        abort-watchdog detection shape — rather than failing the in-flight
+        collective, so the step is re-planned, not discarded."""
+        self._dead_members.append(int(group_rank))
+        fwd = getattr(self._pg, "inject_group_member_death", None)
+        if fwd is not None:
+            fwd(group_rank)
+        cb = self._member_death_cb
+        if cb is not None:
+            cb(int(group_rank))
+
+    @property
+    def dead_members(self) -> List[int]:
+        """Group ranks this wrapper has killed (test assertions)."""
+        return list(self._dead_members)
 
     # -- compressed-ring failover passthroughs ----------------------------
     # (EventInjector.kill_link and the Manager's reroute counter reach the

@@ -437,6 +437,9 @@ class ProcessGroupXLA(ProcessGroup):
         self._error: Optional[Exception] = None
         self._dispatch_q: Optional[Any] = None  # distributed-mode op stream
         self._device_world_epoch = 0
+        # last successful configure args, kept for the intra-group degrade
+        # path (prepare_shrink re-lands the same world coordinates)
+        self._last_configure: Optional[Tuple[str, int, int, int]] = None
 
     @property
     def requires_sync_quorum(self) -> bool:
@@ -546,6 +549,11 @@ class ProcessGroupXLA(ProcessGroup):
             # pointer here when local mode can't cover the world).
             mode = "local"
 
+        with self._lock:
+            self._last_configure = (
+                store_addr, replica_rank, replica_world_size, quorum_id
+            )
+
         if mode == "local":
             self._retire_current_world()
             world = self._configure_local(store_addr, replica_world_size, quorum_id)
@@ -560,6 +568,54 @@ class ProcessGroupXLA(ProcessGroup):
                 coord, replica_rank, replica_world_size, quorum_id
             )
             self._install_world(world, replica_rank, replica_world_size)
+
+        return commit
+
+    def prepare_shrink(
+        self, dead_group_rank: int
+    ) -> Optional[Callable[[], None]]:
+        """Intra-group degrade path (docs/operations.md#degraded-replicas):
+        a chip INSIDE this replica's group died and the group is shrinking
+        its own TP/PP degree in place rather than leaving the quorum.
+
+        The param movement is the reshard engine's job
+        (torchft_tpu/parallel/degrade.py); this PG's job is to fence the
+        collective generation the dead chip was entangled with. Local mode
+        (one process owns the devices) returns a commit callable that
+        poisons the current world — failing in-flight ops that could be
+        waiting on the dead chip — and re-lands the same world coordinates
+        on a fresh generation; co-resident replicas pick the rebuilt world
+        up at their next configure, exactly like the poisoned-world rebuild
+        on the ordinary reconfigure path. Distributed mode raises: a
+        ``jax.distributed`` world's membership can only change by teardown
+        + rejoin (a hard toolchain invariant), so an in-place shrink is the
+        one reconfiguration this PG cannot stage — the Manager falls back
+        to the classic leave-heal-rejoin path.
+        """
+        with self._lock:
+            world = self._world
+            args = self._last_configure
+        if world is None or args is None:
+            return None  # never configured: nothing is entangled yet
+        if world.distributed:
+            raise RuntimeError(
+                "distributed-mode ProcessGroupXLA cannot shrink intra-group "
+                "membership in place: jax.distributed world membership only "
+                "changes by teardown + rejoin, so a chip loss inside the "
+                "group takes the leave-heal-rejoin path"
+            )
+        store_addr, replica_rank, replica_world_size, quorum_id = args
+
+        def commit() -> None:
+            # poison-and-rebuild: retire fails the stale generation's
+            # slots/mailboxes (ops entangled with the dead chip can never
+            # complete), and _configure_local sees the poisoned registry
+            # entry and builds a fresh world under the same key
+            self._retire_current_world()
+            w = self._configure_local(
+                store_addr, replica_world_size, quorum_id
+            )
+            self._install_world(w, replica_rank, replica_world_size)
 
         return commit
 

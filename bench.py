@@ -942,6 +942,88 @@ def degrade(smoke: bool = False) -> None:
     }))
 
 
+def policy_metrics(smoke: bool = False) -> dict:
+    """Run benchmarks/policy_bench.py in a subprocess (it stands up a
+    lighthouse with the policy engine attached plus a managed loop — own
+    process keeps fd/thread/env blast radius away from the bench harness)
+    and parse its one-line JSON summary."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks",
+        "policy_bench.py",
+    )
+    cmd = [sys.executable, script] + (["--smoke"] if smoke else [])
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True,
+        timeout=600 if smoke else 3600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"policy bench failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip().splitlines()[-8:]}"
+        )
+    last = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")][-1]
+    return _json.loads(last)
+
+
+def policy(smoke: bool = False) -> None:
+    """``python bench.py --policy [--smoke]``: one JSON line with the
+    policy-plane summary. The gates hold the plane's promises
+    (docs/operations.md "Adaptive policies"): the engine's fold over a
+    1000-replica window amortizes to <0.5% of a managed step (its duty
+    cycle at the default 5 s cadence), the offline replay ranks >=2
+    candidate policies against the committed fixture at useful
+    throughput, and at least one versioned frame reached a live
+    manager's quorum safe point (``policy_intents`` in timings — the
+    zero-new-RPC piggyback works end to end). Full runs also write
+    BENCH_POLICY.json."""
+    metrics = policy_metrics(smoke=smoke)
+    required = [
+        "policy_fold_duty_cycle_pct",
+        "replay_events_per_s",
+        "replay_ranking",
+        "replay_winner",
+        "policy_intents",
+    ]
+    missing = [k for k in required if metrics.get(k) is None]
+    if missing:
+        raise RuntimeError(f"policy: missing keys: {missing}")
+    if not metrics["policy_fold_duty_cycle_pct"] < 0.5:
+        raise RuntimeError(
+            f"policy: engine fold duty cycle "
+            f"{metrics['policy_fold_duty_cycle_pct']:.3f}% of the managed "
+            "step budget (gate: <0.5%) — the fold left the advisory-cost "
+            "envelope"
+        )
+    if len(metrics["replay_ranking"]) < 2:
+        raise RuntimeError(
+            "policy: replay must rank >=2 candidate policies, got "
+            f"{metrics['replay_ranking']}"
+        )
+    if not metrics["replay_events_per_s"] >= 1000:
+        raise RuntimeError(
+            f"policy: replay throughput {metrics['replay_events_per_s']} "
+            "events/s under the 1000/s floor — offline scoring regressed"
+        )
+    if not metrics["policy_intents"] >= 1:
+        raise RuntimeError(
+            "policy: no frame reached the manager safe point in observe "
+            "mode — the heartbeat/agg_tick piggyback is broken"
+        )
+    print(json.dumps({
+        "metric": "policy engine fold duty cycle (1000-replica window)",
+        "value": metrics["policy_fold_duty_cycle_pct"],
+        "unit": "%",
+        "vs_baseline": metrics["policy_fold_duty_cycle_pct"],
+        **metrics,
+    }))
+
+
 def main() -> None:
     # shared fallback policy (ensure_responsive_backend): one probe, one
     # timeout story with __graft_entry__.entry(), CPU forced on hung/crash
@@ -1234,6 +1316,10 @@ if __name__ == "__main__":
     if "--degrade" in sys.argv[1:]:
         # loud-failure gate, same policy as --smoke
         degrade(smoke="--smoke" in sys.argv[1:])
+        sys.exit(0)
+    if "--policy" in sys.argv[1:]:
+        # loud-failure gate, same policy as --smoke
+        policy(smoke="--smoke" in sys.argv[1:])
         sys.exit(0)
     if "--smoke" in sys.argv[1:]:
         # no always-emit wrapper here: the smoke gate must fail loudly

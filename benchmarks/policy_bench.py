@@ -1,0 +1,261 @@
+"""Adaptive policy plane cost + replay throughput bench.
+
+Two promises from docs/operations.md "Adaptive policies" are measured
+instead of asserted:
+
+- **The engine is ~free for the fleet.** The lighthouse folds its event
+  ring into signals once per ``TORCHFT_POLICY_INTERVAL_S`` (default 5 s),
+  so the honest per-step accounting is the fold's duty cycle: one
+  fold+evaluate over a 1000-replica window, amortized over the interval.
+  ``policy_fold_duty_cycle_pct`` must stay under 0.5% — equivalently, the
+  amortized fold cost per managed step is <0.5% of that step.
+- **Offline replay is fast enough to iterate on.** ``python -m
+  torchft_tpu.policy replay`` re-folds committed history through the SAME
+  ``fold_signals`` the live engine uses; ``replay_events_per_s`` is the
+  scoring throughput over the committed 1000-replica fixture
+  (``benchmarks/fixtures/policy_history_1000replicas.jsonl.gz``).
+
+It also runs a short LIVE managed loop (the ft_overhead trainer) under
+``TORCHFT_POLICY=observe`` with the engine attached, proving frames reach
+the manager's quorum safe point end to end (``policy_intents`` > 0 in
+``Manager.timings()``) while measuring the managed step the duty cycle is
+quoted against.
+
+The fixture is deterministic (no wall clock, no RNG — a fixed phase
+script over 1000 replicas: calm, a churn storm with link-fault growth,
+recovery) and committed; ``--regen`` rewrites it byte-identically.
+
+    python benchmarks/policy_bench.py [--smoke] [--regen]
+
+Prints one JSON line; ``bench.py --policy`` merges the row into
+BENCH_POLICY.json and ``bench.py --policy --smoke`` is the fast-tier CI
+gate (tests/test_bench_smoke.py).
+"""
+
+import gzip
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(
+    REPO, "benchmarks", "fixtures", "policy_history_1000replicas.jsonl.gz"
+)
+
+N_REPLICAS = 1000
+SPAN_S = 600  # calm 0-200, churn storm 200-400, recovery 400-600
+QUORUM_EVERY_S = 10
+TELEMETRY_EVERY_S = 5
+TELEMETRY_REPORTERS = 50  # replicas that emit telemetry snapshots
+
+
+def _median(xs):
+    return statistics.median(xs) if xs else 0.0
+
+
+def generate_fixture() -> list:
+    """The committed 1000-replica narrative, fully deterministic."""
+    replicas = [f"replica_{i:04d}" for i in range(N_REPLICAS)]
+    events = []
+    seq = 0
+
+    def emit(ts_s, kind, **fields):
+        nonlocal seq
+        seq += 1
+        events.append({"ts_ms": ts_s * 1000, "seq": seq, "kind": kind, **fields})
+
+    counters = {r: 0.0 for r in replicas[:TELEMETRY_REPORTERS]}
+    for t in range(0, SPAN_S + 1, TELEMETRY_EVERY_S):
+        storm = 200 <= t < 400
+        if t % QUORUM_EVERY_S == 0:
+            if storm:
+                # a rotating squall of 20 replicas out per quorum
+                out = {(t // QUORUM_EVERY_S * 7 + j) % N_REPLICAS
+                       for j in range(20)}
+            elif t % 60 == 0 and t > 0:
+                out = {(t // 60) % N_REPLICAS}  # background attrition
+            else:
+                out = set()
+            emit(t, "quorum", quorum_id=t // QUORUM_EVERY_S,
+                 participants=[r for i, r in enumerate(replicas)
+                               if i not in out])
+        if storm and t % 20 == 0:
+            victim = replicas[(t * 13) % N_REPLICAS]
+            emit(t, "eject", replica_id=victim, score=9.5)
+            emit(t + 15, "readmit", replica_id=victim)
+        if storm and t % 40 == 0:
+            emit(t, "straggler_warn",
+                 replica_id=replicas[(t * 31) % N_REPLICAS], score=4.2)
+        for i, rid in enumerate(sorted(counters)):
+            # cumulative link-fault counters: flat when calm, growing
+            # through the storm (the link_quality signal differences these)
+            if storm:
+                counters[rid] += 0.4 + (i % 3) * 0.2
+            emit(t, "telemetry", replica_id=rid, telemetry={
+                "step": t, "step_s": 0.1,
+                "rpc_retries": round(counters[rid], 1),
+                "collective_reroute": round(counters[rid] / 2.0, 1),
+                "chunk_crc_failures": 0,
+            })
+    return events
+
+
+def write_fixture() -> None:
+    os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+    payload = "\n".join(
+        json.dumps(e, sort_keys=True) for e in generate_fixture()
+    )
+    # mtime=0 keeps the gzip byte-identical across regenerations
+    with open(FIXTURE, "wb") as f:
+        with gzip.GzipFile(fileobj=f, mode="wb", mtime=0) as gz:
+            gz.write(payload.encode())
+
+
+def candidate_spec() -> dict:
+    """A second, more aggressive candidate so the replay ranking has a
+    real contest (the builtin is the conservative one)."""
+    return {
+        "name": "aggressive",
+        "rules": [
+            {"name": "any-churn-lengthen", "signal": "churn_per_min",
+             "op": ">", "threshold": 1.0, "release": 0.2,
+             "actions": {"TORCHFT_SYNC_EVERY": "128"}},
+            {"name": "links-compress-hard", "signal": "link_quality",
+             "op": "<", "threshold": 0.99, "release": 0.999,
+             "actions": {"TORCHFT_COMPRESS": "int8"}},
+        ],
+        "clamps": {"TORCHFT_SYNC_EVERY": [1, 512]},
+    }
+
+
+def run(smoke: bool = False) -> dict:
+    from torchft_tpu.policy import (
+        PolicyEngine,
+        PolicySpec,
+        builtin_spec,
+        rank_policies,
+    )
+    from torchft_tpu.tracing import load_history
+
+    if not os.path.exists(FIXTURE):
+        write_fixture()
+    events = load_history(FIXTURE)
+    n_events = len(events)
+
+    # -- offline replay throughput (the shared fold code path) -------------
+    specs = [builtin_spec(), PolicySpec.from_json(candidate_spec())]
+    t0 = time.perf_counter()
+    ranking = rank_policies(events, specs, interval_s=5.0, window_s=300.0)
+    replay_s = time.perf_counter() - t0
+    replay_events_per_s = n_events * len(specs) / replay_s if replay_s else 0.0
+
+    # -- one live-shaped fold+evaluate over the full 1000-replica window ---
+    reps = 5 if smoke else 20
+    fold_times = []
+    for _ in range(reps):
+        engine = PolicyEngine(builtin_spec(), mode="observe", window_s=300.0)
+        engine.feed(list(events))
+        t0 = time.perf_counter()
+        engine.evaluate()
+        fold_times.append(time.perf_counter() - t0)
+    # min, not median: the fold is deterministic code over fixed input, so
+    # the fastest rep is the true cost and everything above it is the
+    # 1-vCPU host's scheduler (the gate must not flake on neighbor load)
+    fold_eval_ms = min(fold_times) * 1000.0
+
+    # -- live managed loop under observe: end-to-end frames + step cost ----
+    import optax  # noqa: F401 — fail here, not mid-loop, if absent
+
+    from train_ddp import build_trainer
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.process_group import ProcessGroupHost
+
+    steps = 12 if smoke else 40
+    interval_s = 0.2  # fast cadence so a short bench still sees frames
+    os.environ["TORCHFT_POLICY"] = "observe"
+    os.environ["TORCHFT_POLICY_INTERVAL_S"] = str(interval_s)
+    state, grad_fn, optimizer, make_batch = build_trainer(0, batch_size=8)
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=200,
+        quorum_tick_ms=20, heartbeat_timeout_ms=2000, policy="builtin",
+    )
+    manager = Manager(
+        pg=ProcessGroupHost(timeout=30.0),
+        load_state_dict=lambda sd: None,
+        state_dict=lambda: {"params": state["params"]},
+        min_replica_size=1,
+        replica_id="policy_bench",
+        lighthouse_addr=f"127.0.0.1:{lh.port}",
+        timeout=30.0,
+    )
+    step_times = []
+    policy_intents = 0.0
+    try:
+        for _ in range(steps):
+            x, y = make_batch()
+            t0 = time.perf_counter()
+            manager.start_quorum()
+            loss, grads = grad_fn(state["params"], x, y)
+            reduced = manager.allreduce(grads).get_future().wait(timeout=60)
+            if manager.should_commit():
+                updates, new_opt = optimizer.update(
+                    grads, state["opt_state"], state["params"]
+                )
+                state["params"] = optax.apply_updates(state["params"], updates)
+                state["opt_state"] = new_opt
+            float(loss)
+            step_times.append(time.perf_counter() - t0)
+            time.sleep(0.05)  # give the 0.2 s policy cadence room to fire
+        # a calm 1-replica fleet trips the builtin calm-tighten-eject rule,
+        # so at least one versioned frame must have reached the safe point
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            manager.start_quorum()
+            policy_intents = manager.timings().get("policy_intents", 0.0)
+            if policy_intents > 0:
+                break
+            time.sleep(0.2)
+    finally:
+        manager.shutdown(wait=False)
+        lh.shutdown()
+        os.environ.pop("TORCHFT_POLICY", None)
+        os.environ.pop("TORCHFT_POLICY_INTERVAL_S", None)
+    managed_step_ms = _median(step_times[2:]) * 1000.0
+
+    # the fold runs once per TORCHFT_POLICY_INTERVAL_S (default 5 s) off
+    # the training hot path; its duty cycle IS the amortized per-step cost
+    # fraction, whatever the step time
+    default_interval_ms = 5000.0
+    duty_pct = fold_eval_ms / default_interval_ms * 100.0
+
+    return {
+        "policy_fold_eval_ms": round(fold_eval_ms, 3),
+        "policy_fold_duty_cycle_pct": round(duty_pct, 4),
+        "managed_step_ms": round(managed_step_ms, 3),
+        "replay_events_per_s": round(replay_events_per_s, 1),
+        "replay_wall_s": round(replay_s, 3),
+        "replay_ranking": [
+            {"policy": r["policy"], "score": r["score"]} for r in ranking
+        ],
+        "replay_winner": ranking[0]["policy"] if ranking else None,
+        "policy_intents": policy_intents,
+        "fixture_events": n_events,
+        "fixture_replicas": N_REPLICAS,
+        "steps": steps,
+        "smoke": smoke,
+    }
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv[1:]:
+        write_fixture()
+        print(f"wrote {FIXTURE}")
+        sys.exit(0)
+    print(json.dumps(run(smoke="--smoke" in sys.argv[1:])))

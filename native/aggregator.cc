@@ -70,6 +70,10 @@ Json Aggregator::rpc_heartbeat(const Json& params) {
   out["health"] = r.health.is_null() ? Json::object() : r.health;
   out["server_ms"] = epoch_millis_now();
   out["aggregated"] = true;
+  // Fan the root's policy frame out to the pod: one frame per tick rides
+  // down to N replicas on replies they already receive. Absent until the
+  // root publishes one, so pre-policy pods see an unchanged reply.
+  if (policy_frame_.is_object()) out["policy"] = policy_frame_;
   return out;
 }
 
@@ -182,6 +186,11 @@ void Aggregator::apply_tick_response_locked(const Json& resp) {
   }
   if (resp.contains("quorum_gen"))
     root_quorum_gen_ = resp.get("quorum_gen").as_int();
+  // Cache the newest policy frame for pod fan-out. Unknown response keys
+  // are otherwise ignored (forward-compat: an older aggregator build
+  // simply never looks at "policy" and keeps working).
+  if (resp.contains("policy") && resp.get("policy").is_object())
+    policy_frame_ = resp.get("policy");
   if (resp.contains("quorum") && !resp.get("quorum").is_null()) {
     latest_quorum_ = QuorumSnapshot::from_json(resp.get("quorum"));
     quorum_gen_ += 1;

@@ -107,6 +107,9 @@ class Aggregator {
   int64_t root_quorum_gen_ = 0;  // root's broadcast generation we've seen
   uint64_t quorum_gen_ = 0;      // local fan-out generation
   std::optional<QuorumSnapshot> latest_quorum_;
+  // Newest policy frame seen on a tick response; fanned out to the pod on
+  // heartbeat replies. Null until the root publishes one.
+  Json policy_frame_;
 
   std::atomic<bool> running_{true};
   std::unique_ptr<RpcServer> server_;

@@ -96,11 +96,40 @@ int tft_lighthouse_new_v2(const char* opts_json, void** out, char** err) {
     opts.heartbeat_timeout_ms =
         j.get_or("heartbeat_timeout_ms", Json(int64_t{5000})).as_int();
     opts.history_path = j.get_or("history_path", Json("")).as_string();
+    opts.policy_ring = j.get_or("policy_ring", Json(int64_t{0})).as_int();
     opts.metrics_per_replica_limit =
         j.get_or("metrics_per_replica_limit", Json(int64_t{64})).as_int();
     HealthOpts health =
         HealthOpts::from_json(j.get_or("health", Json::object()));
     *out = new Lighthouse(bind, opts, health);
+    return TFT_OK;
+  })
+}
+
+// ---- policy plane: in-process control surface on the lighthouse handle.
+// These are C-API calls for the co-located policy engine, NOT wire RPCs —
+// the wire protocol stays at its five methods; frames ride existing
+// heartbeat/agg_tick replies.
+int tft_lighthouse_set_policy(void* h, const char* frame_json, char** err) {
+  TFT_TRY({
+    static_cast<Lighthouse*>(h)->set_policy(Json::parse(frame_json));
+    return TFT_OK;
+  })
+}
+
+char* tft_lighthouse_policy(void* h) {
+  return dup_str(static_cast<Lighthouse*>(h)->policy_json());
+}
+
+char* tft_lighthouse_drain_events(void* h) {
+  return dup_str(static_cast<Lighthouse*>(h)->drain_events());
+}
+
+int tft_lighthouse_retune_health(void* h, const char* partial_json, char** out,
+                                 char** err) {
+  TFT_TRY({
+    *out = dup_str(
+        static_cast<Lighthouse*>(h)->retune_health(Json::parse(partial_json)));
     return TFT_OK;
   })
 }
@@ -183,6 +212,10 @@ int tft_manager_publish_telemetry(void* h, const char* telemetry_json,
 
 char* tft_manager_health(void* h) {
   return dup_str(static_cast<ManagerServer*>(h)->health_json());
+}
+
+char* tft_manager_policy(void* h) {
+  return dup_str(static_cast<ManagerServer*>(h)->policy_json());
 }
 
 char* tft_manager_clock_skew(void* h) {

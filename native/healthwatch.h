@@ -101,6 +101,10 @@ class HealthLedger {
                int64_t min_replicas);
 
   const HealthOpts& opts() const { return opts_; }
+  // Live retune (policy plane): thresholds apply from the next evaluate;
+  // existing window samples, strikes and probation clocks are preserved.
+  // Caller holds the lighthouse mutex (same discipline as on_heartbeat).
+  void set_opts(HealthOpts opts) { opts_ = std::move(opts); }
 
   // Feed one heartbeat; telemetry may be null (plain beat). Returns the
   // policy events this beat produced ({"kind": "straggler_warn" | "eject" |

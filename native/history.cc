@@ -13,13 +13,39 @@ HistoryStore::HistoryStore(std::string path) : path_(std::move(path)) {
   if (!out_.is_open()) path_.clear();  // unwritable -> disabled, not fatal
 }
 
+void HistoryStore::enable_ring(int64_t cap) {
+  std::lock_guard<std::mutex> lk(mu_);
+  ring_cap_ = cap > 0 ? cap : 0;
+  if (ring_cap_ == 0) ring_.clear();
+}
+
+bool HistoryStore::ring_enabled() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return ring_cap_ > 0;
+}
+
+std::vector<Json> HistoryStore::drain_ring() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Json> out(ring_.begin(), ring_.end());
+  ring_.clear();
+  return out;
+}
+
 void HistoryStore::append(Json event) {
-  if (path_.empty()) return;
   try {
     std::lock_guard<std::mutex> lk(mu_);
+    if (path_.empty() && ring_cap_ == 0) return;
     seq_ += 1;
     event["seq"] = seq_;
     event["ts_ms"] = epoch_millis_now();
+    if (ring_cap_ > 0) {
+      if (static_cast<int64_t>(ring_.size()) >= ring_cap_) {
+        ring_.pop_front();  // oldest-out: the fold wants the recent window
+        ring_dropped_ += 1;
+      }
+      ring_.push_back(event);
+    }
+    if (path_.empty()) return;
     out_ << event.dump() << "\n";
     // Flush per event: the store exists for postmortems and live replay;
     // a buffered tail lost to a crash defeats both. Event rates are

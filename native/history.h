@@ -12,9 +12,11 @@
 #pragma once
 
 #include <cstdint>
+#include <deque>
 #include <fstream>
 #include <mutex>
 #include <string>
+#include <vector>
 
 #include "json.h"
 
@@ -29,6 +31,18 @@ class HistoryStore {
   bool enabled() const { return !path_.empty(); }
   const std::string& path() const { return path_; }
 
+  // Live policy stream: an optional bounded in-memory ring that records
+  // the same stamped events the file would, so an in-process consumer
+  // (the policy engine) can fold them without a file round-trip. A store
+  // is "recording" when either sink is active; the ring works with an
+  // empty path (telemetry-only deployments) and alongside one.
+  void enable_ring(int64_t cap);
+  bool ring_enabled() const;
+  bool recording() const { return enabled() || ring_enabled(); }
+
+  // Drain (move out) the ring contents accumulated since the last drain.
+  std::vector<Json> drain_ring();
+
   // Append one event line. The event must carry a "kind" field; the store
   // stamps "seq" (monotonic per store) and "ts_ms" (epoch millis). IO
   // errors are swallowed: history must never take down the control plane.
@@ -41,6 +55,9 @@ class HistoryStore {
   mutable std::mutex mu_;
   std::ofstream out_;
   int64_t seq_ = 0;
+  int64_t ring_cap_ = 0;  // 0 = ring disabled
+  int64_t ring_dropped_ = 0;
+  std::deque<Json> ring_;
 };
 
 // Pure fold over a history event array -> deterministic summary. Mirrored

@@ -53,6 +53,9 @@ Lighthouse::Lighthouse(const std::string& bind, LighthouseOpts opts,
       ledger_(std::move(health), opts.heartbeat_timeout_ms,
               opts.min_replicas),
       history_(opts.history_path) {
+  // Policy event stream: the same events the file sink records, kept in a
+  // bounded ring so the in-process policy engine can fold them live.
+  if (opts.policy_ring > 0) history_.enable_ring(opts.policy_ring);
   server_ = std::make_unique<RpcServer>(
       bind,
       [this](const std::string& m, const Json& p, TimePoint d) {
@@ -155,7 +158,7 @@ void Lighthouse::quorum_tick_locked() {
   quorum_gen_ += 1;
   quorum_cv_.notify_all();
 
-  if (history_.enabled()) {
+  if (history_.recording()) {
     int64_t min_step = participants.front().step;
     int64_t max_step = participants.front().step;
     Json rids = Json::array();
@@ -251,7 +254,7 @@ void Lighthouse::apply_beat_locked(const std::string& replica_id,
   apply_health_events_locked(ledger_.on_heartbeat(replica_id, telemetry, now));
   // History: sample one telemetry snapshot per (replica, step) — beats
   // re-sending the same payload cost nothing, matching the ledger's dedup.
-  if (history_.enabled() && telemetry != nullptr) {
+  if (history_.recording() && telemetry != nullptr) {
     int64_t step = telemetry->get_or("step", Json(int64_t{-1})).as_int();
     auto it = history_telemetry_step_.find(replica_id);
     if (it == history_telemetry_step_.end() || it->second != step) {
@@ -307,6 +310,10 @@ Json Lighthouse::rpc_heartbeat(const Json& params) {
     std::string agg = pick_aggregator_locked(now);
     if (!agg.empty()) out["aggregator"] = agg;
   }
+  // Optional policy frame piggyback (flat fleets get it directly on the
+  // beat reply). Pre-policy managers ignore unknown reply keys, so this is
+  // invisible to them; with no frame set, the reply is byte-identical.
+  if (policy_frame_.is_object()) out["policy"] = policy_frame_;
   return out;
 }
 
@@ -406,7 +413,49 @@ Json Lighthouse::rpc_agg_tick(const Json& params) {
     }
     out["health"] = h;
   }
+  // Policy frame piggyback: the aggregator caches the newest frame and
+  // fans it out to its pod on heartbeat replies. Riding the existing tick
+  // means zero new RPC methods; pre-policy aggregators ignore the key.
+  if (policy_frame_.is_object()) out["policy"] = policy_frame_;
   return out;
+}
+
+void Lighthouse::set_policy(const Json& frame) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // An empty object (or non-object) clears the frame — the kill switch:
+  // replies go back to their pre-policy shape on the next beat/tick.
+  if (frame.is_object() && !frame.as_object().empty())
+    policy_frame_ = frame;
+  else
+    policy_frame_ = Json();
+}
+
+std::string Lighthouse::policy_json() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return policy_frame_.is_object() ? policy_frame_.dump() : "{}";
+}
+
+std::string Lighthouse::drain_events() {
+  // The ring is internally locked; skipping mu_ keeps the engine's poll
+  // off the quorum/beat critical path.
+  Json out = Json::array();
+  for (auto& e : history_.drain_ring()) out.push_back(std::move(e));
+  return out.dump();
+}
+
+std::string Lighthouse::retune_health(const Json& partial) {
+  std::lock_guard<std::mutex> lk(mu_);
+  Json merged = ledger_.opts().to_json();
+  if (partial.is_object()) {
+    for (const auto& [k, v] : partial.as_object()) merged[k] = v;
+  }
+  HealthOpts next = HealthOpts::from_json(merged);
+  ledger_.set_opts(next);
+  Json e = Json::object();
+  e["kind"] = std::string("health_retune");
+  e["opts"] = next.to_json();
+  history_.append(e);
+  return next.to_json().dump();
 }
 
 void Lighthouse::apply_health_events_locked(const std::vector<Json>& events) {
@@ -493,6 +542,12 @@ std::string Lighthouse::metrics_text() {
      << "torchft_lighthouse_history_events_total "
      << history_.events_written() << "\n";
 
+  gauge("torchft_lighthouse_policy_seq",
+        "Version of the policy frame riding beat/tick replies (0 = none)",
+        policy_frame_.is_object()
+            ? static_cast<double>(
+                  policy_frame_.get_or("policy_seq", Json(int64_t{0})).as_int())
+            : 0.0);
   gauge("torchft_lighthouse_aggregators",
         "Live lighthouse aggregators in the registry",
         static_cast<double>(aggregators_.size()));

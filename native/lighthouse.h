@@ -28,6 +28,20 @@ class Lighthouse {
   std::string address() const;
   void shutdown();
 
+  // ---- policy plane (in-process control surface; NOT wire RPCs) ----
+  // Install/replace the versioned policy frame {policy_seq, mode,
+  // knob_overrides} piggybacked on every heartbeat / agg_tick reply.
+  // An empty object clears the frame (kill switch).
+  void set_policy(const Json& frame);
+  // Current policy frame ("{}" when none is set).
+  std::string policy_json();
+  // Drain the live history ring (enable via LighthouseOpts::policy_ring)
+  // as a JSON array — the policy engine's live event feed.
+  std::string drain_events();
+  // Live-retune the health ledger thresholds (partial HealthOpts JSON
+  // merged over the current opts). Returns the resulting opts as JSON.
+  std::string retune_health(const Json& partial);
+
  private:
   Json handle(const std::string& method, const Json& params, TimePoint deadline);
   std::tuple<std::string, std::string, std::string> handle_http(
@@ -84,6 +98,10 @@ class Lighthouse {
   uint64_t quorum_gen_ = 0;
   std::optional<QuorumSnapshot> latest_quorum_;
   std::string last_reason_;  // dedup logging (reference ChangeLogger)
+  // Latest policy frame (set_policy). Type::Null until first set; carried
+  // as an optional "policy" key on heartbeat / agg_tick replies so the
+  // distribution rides the existing wire with zero new RPC methods.
+  Json policy_frame_;
 
   std::atomic<bool> running_{true};
   std::unique_ptr<RpcServer> server_;

@@ -60,6 +60,11 @@ std::string ManagerServer::health_json() const {
   return last_health_.empty() ? "{}" : last_health_;
 }
 
+std::string ManagerServer::policy_json() const {
+  std::lock_guard<std::mutex> lk(telemetry_mu_);
+  return last_policy_.empty() ? "{}" : last_policy_;
+}
+
 std::string ManagerServer::clock_skew_json() const {
   std::lock_guard<std::mutex> lk(telemetry_mu_);
   Json j = Json::object();
@@ -129,6 +134,10 @@ void ManagerServer::heartbeat_loop() {
             std::lock_guard<std::mutex> lk(telemetry_mu_);
             last_health_ = resp.get("health").dump();
           }
+          if (resp.contains("policy")) {
+            std::lock_guard<std::mutex> lk(telemetry_mu_);
+            last_policy_ = resp.get("policy").dump();
+          }
           // No skew update: the aggregator answers with ITS clock, not the
           // root lighthouse's — mixing the two would corrupt the estimate.
           sent = true;
@@ -155,6 +164,10 @@ void ManagerServer::heartbeat_loop() {
         if (resp.contains("health")) {
           std::lock_guard<std::mutex> lk(telemetry_mu_);
           last_health_ = resp.get("health").dump();
+        }
+        if (resp.contains("policy")) {
+          std::lock_guard<std::mutex> lk(telemetry_mu_);
+          last_policy_ = resp.get("policy").dump();
         }
         // Skew vs the lighthouse: the round-trip midpoint against server_ms.
         // Sign convention is replica-minus-lighthouse (positive when THIS

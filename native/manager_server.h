@@ -52,6 +52,12 @@ class ManagerServer {
   void publish_telemetry(const std::string& telemetry_json);
   std::string health_json() const;  // "{}" until the first beat round-trips
 
+  // Policy plane: the latest versioned policy frame carried on a heartbeat
+  // reply (directly from the root, or fanned out by the pod aggregator).
+  // "{}" until a frame arrives. The Manager polls this at its quorum safe
+  // point; the beat loop never interprets the frame.
+  std::string policy_json() const;
+
   // Clock skew vs the lighthouse, estimated from heartbeat round-trips:
   // the midpoint of this side's send/receive epoch times minus the
   // response's server_ms — replica-minus-lighthouse, positive when this
@@ -107,6 +113,7 @@ class ManagerServer {
   mutable std::mutex telemetry_mu_;
   Json telemetry_;            // latest published payload (null = none)
   std::string last_health_;   // last heartbeat response's "health" field
+  std::string last_policy_;   // last heartbeat response's "policy" frame
   // Skew estimate state (guarded by telemetry_mu_).
   double best_skew_ms_ = 0.0;
   double best_rtt_ms_ = 0.0;

@@ -57,6 +57,9 @@ struct LighthouseOpts {
   int64_t heartbeat_timeout_ms = 5000;
   // Recorded-history JSONL path (history.h); empty = disabled.
   std::string history_path;
+  // Policy event stream: >0 enables the in-memory history ring of that
+  // capacity so the policy engine can fold live events without a file.
+  int64_t policy_ring = 0;
   // /metrics cardinality cap: per-replica series are emitted for at most
   // this many replicas (lexicographic); the tail collapses into aggregate
   // min/median/max series so a 1000-replica fleet can't melt the scraper.

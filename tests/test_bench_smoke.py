@@ -22,7 +22,11 @@ stay a small fraction of the managed step. `--degrade --smoke` is the
 gate for the degrade-in-place plane: killing one chip of a 4-chip
 replica group must reshard in place faster than the classic
 leave-heal-rejoin cycle with the quorum never shrinking and the
-shrunken layout bitwise-equal."""
+shrunken layout bitwise-equal. `--policy --smoke` is the gate for the
+adaptive policy plane: the engine's 1000-replica fold must amortize to
+<0.5% of a managed step, the offline replay must rank >=2 candidate
+specs against the committed fixture, and a versioned frame must reach a
+live manager's quorum safe point over the existing wire."""
 
 import json
 import os
@@ -180,6 +184,22 @@ def test_bench_degrade_smoke_beats_rejoin_and_keeps_quorum():
         assert row["reshard_mode"] == "peer"
         assert row["group_degree_after"] == row["degree"] - 1
         assert 0 < row["reshard_bytes_sourced"] < row["reshard_bytes_moved"]
+
+
+def test_bench_policy_smoke_stays_cheap_and_ranks_candidates():
+    rec = _run_bench("--policy", "--smoke")
+    # the smoke run itself gates these (<0.5% fold duty cycle, >=2-way
+    # replay ranking, a frame at the safe point); re-check the
+    # load-bearing ones here so a silently-weakened policy() still fails
+    assert rec["policy_fold_duty_cycle_pct"] < 0.5
+    assert rec["policy_fold_eval_ms"] > 0
+    assert rec["replay_events_per_s"] >= 1000
+    assert len(rec["replay_ranking"]) >= 2
+    assert rec["replay_winner"] == rec["replay_ranking"][0]["policy"]
+    # the zero-new-RPC piggyback delivered a versioned frame to a live
+    # manager's quorum safe point in observe mode
+    assert rec["policy_intents"] >= 1
+    assert rec["fixture_replicas"] == 1000
 
 
 def test_bench_serving_smoke_sustains_traffic_through_kill():

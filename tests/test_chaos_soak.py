@@ -1682,3 +1682,233 @@ def test_chip_kill_degrades_in_place_restores_converges(monkeypatch):
             err_msg=f"replica {rid} diverged across the in-place degrade",
         )
     assert np.isfinite(finals[0]).all()
+
+
+@pytest.mark.slow
+def test_policy_adapts_to_churn_and_relaxes():
+    """Adaptive-policy chaos phase: a flapping replica churns the quorum
+    while a steady replica trains. The lighthouse-side policy engine
+    (enforce mode, a dedicated churn-only spec) must fold the REAL event
+    ring into a churn signal, push a versioned frame over the existing
+    heartbeat wire, and retarget knobs at the steady replica's quorum
+    safe point — lengthening the sync cadence and widening the eject
+    threshold while the storm lasts. When the flapper settles down the
+    hysteresis band must RELEASE: the sync override reverts (adjusters
+    told to restore, the override layer emptied of it) and the calm rule
+    tightens the eject threshold instead. Throughout, the run must end
+    with the readmitted flapper bitwise-equal to the steady replica —
+    adaptation may only move knobs, never training math."""
+    import json
+    import tempfile
+
+    from torchft_tpu import knobs
+
+    target = 30
+    step_sleep_s = 0.1
+    flap_steps = 2  # steps each flapper incarnation lives for
+    spec = {
+        "name": "churn-only",
+        "rules": [
+            {"name": "calm-tighten-eject", "signal": "churn_per_min",
+             "op": "<", "threshold": 0.5, "release": 2.0,
+             "actions": {"TORCHFT_HEALTH_EJECT_Z": "5.0"}},
+            {"name": "churn-lengthen-sync", "signal": "churn_per_min",
+             "op": ">", "threshold": 6.0, "release": 2.0,
+             "actions": {"TORCHFT_SYNC_EVERY": "64",
+                         "TORCHFT_HEALTH_EJECT_Z": "9.0"}},
+        ],
+        "clamps": {"TORCHFT_SYNC_EVERY": [1, 512],
+                   "TORCHFT_HEALTH_EJECT_Z": [3.0, 12.0]},
+    }
+    spec_file = tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    )
+    json.dump(spec, spec_file)
+    spec_file.close()
+
+    os.environ["TORCHFT_POLICY"] = "enforce"
+    os.environ["TORCHFT_POLICY_INTERVAL_S"] = "0.2"
+    # a short window so the storm clears the signal within the test
+    os.environ["TORCHFT_POLICY_WINDOW_S"] = "8"
+    lh = LighthouseServer(
+        bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=1000,
+        quorum_tick_ms=20, heartbeat_timeout_ms=800,
+        health={"mode": "off"}, policy=spec_file.name,
+    )
+    assert lh.policy_controller is not None
+
+    finals: dict = {}
+    managers: dict = {}
+    adjusted: list = []  # TORCHFT_SYNC_EVERY adjuster calls on replica 0
+    fleet_done = threading.Event()
+    churn_done = threading.Event()
+    failure: list = []
+    phases: dict = {}
+
+    def make_manager(rid: int, params: dict) -> Manager:
+        def load(sd):
+            params["w"] = np.array(np.asarray(sd["w"]), dtype=np.float32)
+
+        return Manager(
+            pg=ProcessGroupHost(timeout=8.0),
+            load_state_dict=load,
+            state_dict=lambda: {"w": params["w"].copy()},
+            min_replica_size=1,
+            use_async_quorum=True,
+            replica_id=f"polsoak_{rid}",
+            lighthouse_addr=f"127.0.0.1:{lh.port}",
+            timeout=8.0,
+            quorum_timeout=4.0,
+            # beats must outpace steps so telemetry keeps event time
+            # advancing (the fold is event-time driven: a silent ring
+            # would freeze the churn signal at the storm's peak)
+            heartbeat_interval=0.02,
+        )
+
+    def train_loop(rid: int, manager: Manager, params: dict) -> None:
+        grad_base = np.random.RandomState(800 + rid).randn(8).astype(
+            np.float32
+        )
+        zgrads = {"w": np.zeros(8, np.float32)}
+        while manager.current_step() < target:
+            manager.start_quorum()
+            if manager.current_step() >= target:
+                manager.allreduce(zgrads).get_future().wait(30)
+                if manager.should_commit():
+                    break
+                continue
+            step = manager.current_step()
+            time.sleep(step_sleep_s)
+            g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+            avg = manager.allreduce({"w": g}).get_future().wait(30)
+            if manager.should_commit():
+                params["w"] = (
+                    params["w"] - LR * np.asarray(avg["w"])
+                ).astype(np.float32)
+        finals[rid] = params["w"].copy()
+        # keep hitting quorum safe points (and emitting telemetry beats)
+        # until the whole phase is over — the relax frame lands here
+        while not fleet_done.is_set():
+            manager.start_quorum()
+            manager.allreduce(zgrads).get_future().wait(30)
+            manager.should_commit()
+
+    def steady() -> None:
+        params = {"w": np.zeros(8, np.float32)}
+        manager = make_manager(0, params)
+        managers[0] = manager
+        manager.register_policy_adjuster(
+            "TORCHFT_SYNC_EVERY", adjusted.append
+        )
+        try:
+            train_loop(0, manager, params)
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+        finally:
+            manager.shutdown(wait=False)
+
+    def flapper() -> None:
+        try:
+            # churn storm: join, run a couple of steps, leave, repeat —
+            # every departure+rejoin is two membership deltas in the ring
+            while not churn_done.is_set() and not fleet_done.is_set():
+                params = {"w": np.zeros(8, np.float32)}
+                manager = make_manager(1, params)
+                grad_base = np.random.RandomState(801).randn(8).astype(
+                    np.float32
+                )
+                for _ in range(flap_steps):
+                    manager.start_quorum()
+                    step = manager.current_step()
+                    g = (grad_base * (1.0 + 0.01 * step)).astype(np.float32)
+                    avg = manager.allreduce({"w": g}).get_future().wait(30)
+                    if manager.should_commit():
+                        params["w"] = (
+                            params["w"] - LR * np.asarray(avg["w"])
+                        ).astype(np.float32)
+                manager.shutdown(wait=False)
+                # long enough for the 800 ms heartbeat timeout to drop us
+                # from the quorum before we rejoin
+                churn_done.wait(1.2)
+            # calm phase: rejoin for good, heal from the steady peer,
+            # train to target alongside it
+            params = {"w": np.zeros(8, np.float32)}
+            manager = make_manager(1, params)
+            managers[1] = manager
+            try:
+                train_loop(1, manager, params)
+            finally:
+                manager.shutdown(wait=False)
+        except BaseException as e:  # noqa: BLE001
+            failure.append(e)
+            raise
+
+    def _wait(pred, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if failure:
+                raise AssertionError(f"replica failed: {failure}")
+            if pred():
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"timed out waiting for {msg}; overrides={knobs.get_overrides()}"
+            f" timings={managers[0].timings() if 0 in managers else {}}"
+        )
+
+    ex = ThreadPoolExecutor(max_workers=2)
+    try:
+        futs = [ex.submit(steady), ex.submit(flapper)]
+        # storm: the engine must see the churn and enforce the overrides
+        # at the steady replica's safe point
+        _wait(
+            lambda: knobs.get_overrides().get("TORCHFT_SYNC_EVERY") == "64",
+            timeout=60.0, msg="churn rule enforced",
+        )
+        phases["adapted"] = dict(knobs.get_overrides())
+        churn_done.set()
+        # calm: the hysteresis band must release and revert the override
+        _wait(
+            lambda: "TORCHFT_SYNC_EVERY" not in knobs.get_overrides(),
+            timeout=90.0, msg="churn rule released",
+        )
+        phases["relaxed"] = dict(knobs.get_overrides())
+        _wait(
+            lambda: {0, 1} <= set(finals), timeout=120.0,
+            msg="both replicas reaching target",
+        )
+        fleet_done.set()
+        for f in futs:
+            f.result(timeout=60.0)
+    finally:
+        fleet_done.set()
+        churn_done.set()
+        ex.shutdown(wait=False, cancel_futures=True)
+        lh.shutdown()
+        knobs.clear_overrides()
+        for var in ("TORCHFT_POLICY", "TORCHFT_POLICY_INTERVAL_S",
+                    "TORCHFT_POLICY_WINDOW_S"):
+            os.environ.pop(var, None)
+        os.unlink(spec_file.name)
+
+    assert not failure, failure
+    # the storm frame carried both actions of the churn rule
+    assert phases["adapted"]["TORCHFT_HEALTH_EJECT_Z"] == "9.0", phases
+    # the relax frame dropped the sync override (and, once fully calm,
+    # the calm rule tightens the eject threshold instead)
+    assert "TORCHFT_SYNC_EVERY" not in phases["relaxed"], phases
+    # the live adjuster saw the retarget AND the restore (None)
+    assert "64" in adjusted and None in adjusted, adjusted
+    t = managers[0].timings()
+    assert t["policy_applies"] >= 2.0, t  # storm frame + relax frame
+    status = managers[0].policy_status()
+    assert status["mode"] == "enforce"
+    assert status["policy_seq"] >= 2
+    # adaptation never touched the math: the readmitted flapper agrees
+    # with the steady replica bitwise
+    np.testing.assert_array_equal(
+        finals[0], finals[1],
+        err_msg="flapper diverged from steady replica under policy churn",
+    )
+    assert np.isfinite(finals[0]).all()

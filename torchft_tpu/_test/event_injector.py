@@ -33,7 +33,94 @@ from typing import Dict, Optional, Tuple
 
 from torchft_tpu.process_group import FakeProcessGroupWrapper
 
-__all__ = ["EventInjector", "InjectedFailure", "EventKind"]
+__all__ = [
+    "EventInjector",
+    "InjectedFailure",
+    "EventKind",
+    "churn_burst",
+    "mtbf_script",
+]
+
+
+# ------------------------------------------------------ policy-plane input
+# History-style event synthesizers for the adaptive policy plane
+# (torchft_tpu/policy.py): deterministic, wall-clock-free event lists in
+# the exact shape the lighthouse's recorded-history store emits, so tests
+# and benches can drive precise failure-rate signals through
+# ``fold_signals`` / ``PolicyEngine.feed`` without killing anything real.
+def churn_burst(
+    n: int,
+    period_s: float,
+    replicas: int = 4,
+    start_ms: int = 0,
+    seq0: int = 0,
+) -> list:
+    """``n`` depart/rejoin churn cycles, one every ``period_s`` seconds.
+
+    Each cycle is two quorum membership events: replica ``i % replicas``
+    missing (one departure = one failure + one churn unit), then the full
+    set back half a period later (one join = one churn unit). Folded over
+    a window covering all of it this yields ``churn_per_min ==
+    2 * n / (span / 60)`` exactly.
+    """
+    full = [f"replica_{r}" for r in range(replicas)]
+    seq = seq0
+    events = [
+        {
+            "ts_ms": start_ms,
+            "seq": seq,
+            "kind": "quorum",
+            "participants": list(full),
+        }
+    ]
+    period_ms = int(period_s * 1000.0)
+    for i in range(n):
+        t = start_ms + (i + 1) * period_ms
+        down = [p for p in full if p != full[i % replicas]]
+        seq += 1
+        events.append(
+            {"ts_ms": t, "seq": seq, "kind": "quorum", "participants": down}
+        )
+        seq += 1
+        events.append(
+            {
+                "ts_ms": t + period_ms // 2,
+                "seq": seq,
+                "kind": "quorum",
+                "participants": list(full),
+            }
+        )
+    return events
+
+
+def mtbf_script(
+    intervals_s: list,
+    replica: str = "replica_0",
+    start_ms: int = 0,
+    seq0: int = 0,
+) -> list:
+    """Eject events spaced by the given inter-failure intervals.
+
+    ``mtbf_script([30, 30, 30])`` yields three failures across 90 s of
+    event time — folded over a matching window the engine sees ``mtbf_s
+    == span / 3``. Use alongside :func:`churn_burst` (offset ``seq0`` /
+    ``start_ms`` to interleave) to compose richer fleet narratives.
+    """
+    events = []
+    t = start_ms
+    seq = seq0
+    for dt in intervals_s:
+        t += int(float(dt) * 1000.0)
+        seq += 1
+        events.append(
+            {
+                "ts_ms": t,
+                "seq": seq,
+                "kind": "eject",
+                "replica_id": replica,
+            }
+        )
+    return events
 
 
 class InjectedFailure(Exception):

@@ -50,6 +50,10 @@ DECLARED_TIMINGS: Dict[str, str] = {
     "straggler_score": "quorum-relative modified z-score",
     "ejections": "cumulative proactive ejections of this replica",
     "readmissions": "cumulative probationary readmissions",
+    # policy plane (adaptive FT control, quorum-safe-point application)
+    "policy_seq": "latest policy frame sequence seen at a safe point",
+    "policy_applies": "frames whose overrides were enforced live",
+    "policy_intents": "frames recorded in observe mode (no knob touched)",
     # degrade plane (in-place TP/PP shrink after an intra-group chip loss)
     "degraded_reshard_s": "last in-place k→k-1 reshard wall clock",
     "degrade_events": "cumulative in-place degrades of this replica",

@@ -96,6 +96,19 @@ def _load() -> ctypes.CDLL:
         lib.tft_lighthouse_port.argtypes = [ctypes.c_void_p]
         lib.tft_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
         lib.tft_lighthouse_free.argtypes = [ctypes.c_void_p]
+        # policy plane: in-process control surface (NOT wire RPCs)
+        lib.tft_lighthouse_set_policy.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.tft_lighthouse_policy.argtypes = [ctypes.c_void_p]
+        lib.tft_lighthouse_policy.restype = ctypes.c_void_p
+        lib.tft_lighthouse_drain_events.argtypes = [ctypes.c_void_p]
+        lib.tft_lighthouse_drain_events.restype = ctypes.c_void_p
+        lib.tft_lighthouse_retune_health.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ]
         lib.tft_aggregator_new.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_char_p),
@@ -120,6 +133,8 @@ def _load() -> ctypes.CDLL:
         ]
         lib.tft_manager_health.argtypes = [ctypes.c_void_p]
         lib.tft_manager_health.restype = ctypes.c_void_p
+        lib.tft_manager_policy.argtypes = [ctypes.c_void_p]
+        lib.tft_manager_policy.restype = ctypes.c_void_p
         lib.tft_manager_clock_skew.argtypes = [ctypes.c_void_p]
         lib.tft_manager_clock_skew.restype = ctypes.c_void_p
         lib.tft_manager_port.argtypes = [ctypes.c_void_p]
@@ -331,6 +346,7 @@ class LighthouseServer:
         serve_registry: bool = False,
         serve_drain_on: "Optional[str]" = None,
         redundancy_directory: bool = False,
+        policy: "Optional[str]" = None,
     ) -> None:
         """``health`` configures the healthwatch ledger (HealthOpts fields,
         see torchft_tpu/healthwatch.py); None reads ``TORCHFT_HEALTH_*``
@@ -348,8 +364,25 @@ class LighthouseServer:
         ``redundancy_directory=True`` co-hosts a redundancy-plane
         ShardDirectory that tracks erasure-coded shard placements, polls
         this lighthouse's /health ledger for owner deaths, and promotes
-        hot spares into the next quorum (docs/operations.md)."""
+        hot spares into the next quorum (docs/operations.md).
+        ``policy`` attaches the adaptive policy engine: ``"builtin"`` or a
+        PolicySpec JSON path (None reads ``TORCHFT_POLICY_SPEC`` when
+        ``TORCHFT_POLICY`` != off). The engine folds this lighthouse's
+        live event ring into fleet signals every
+        ``TORCHFT_POLICY_INTERVAL_S`` and publishes versioned knob-
+        override frames on existing heartbeat/agg_tick replies; see
+        docs/operations.md#adaptive-policies."""
+        from torchft_tpu import knobs
+
         lib = _load()
+        policy_mode = knobs.env_str("TORCHFT_POLICY", "off").strip() or "off"
+        if policy is None and policy_mode != "off":
+            policy = knobs.env_str("TORCHFT_POLICY_SPEC", "builtin") or "builtin"
+        policy_ring = (
+            knobs.env_int("TORCHFT_POLICY_RING", 4096)
+            if policy is not None and policy_mode != "off"
+            else 0
+        )
         if health is None:
             from torchft_tpu.healthwatch import HealthConfig
 
@@ -368,6 +401,7 @@ class LighthouseServer:
             "heartbeat_timeout_ms": heartbeat_timeout_ms,
             "health": health,
             "history_path": history_path,
+            "policy_ring": policy_ring,
             "metrics_per_replica_limit": metrics_per_replica_limit,
         }
         status = lib.tft_lighthouse_new_v2(
@@ -400,6 +434,93 @@ class LighthouseServer:
             self.redundancy_directory = ShardDirectory(
                 lighthouse_addr=self.address()
             )
+        self.policy_controller = None
+        self.policy_mode = policy_mode
+        self._policy_thread = None
+        self._policy_stop = None
+        if policy is not None and policy_mode != "off":
+            self._attach_policy(policy, policy_mode)
+
+    def _attach_policy(self, policy: str, mode: str) -> None:
+        """Python-side lazy attach (same pattern as serve_registry /
+        redundancy_directory): a PolicyController polling the native
+        handle's event ring on a daemon thread."""
+        import threading
+
+        from torchft_tpu import knobs
+        from torchft_tpu.policy import (
+            PolicyController,
+            PolicyEngine,
+            PolicySpec,
+        )
+
+        spec = PolicySpec.load(policy)
+        engine = PolicyEngine(
+            spec,
+            mode=mode,
+            window_s=knobs.env_float("TORCHFT_POLICY_WINDOW_S", 300.0),
+        )
+        self.policy_controller = PolicyController(
+            engine,
+            drain_fn=self._policy_drain,
+            set_policy_fn=self.set_policy,
+            retune_health_fn=self.retune_health,
+        )
+        interval_s = max(knobs.env_float("TORCHFT_POLICY_INTERVAL_S", 5.0), 0.05)
+        stop = threading.Event()
+
+        def _loop() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    self.policy_controller.step()
+                except Exception:  # noqa: BLE001 — the policy plane must
+                    pass  # never take down the quorum coordinator
+
+        self._policy_stop = stop
+        self._policy_thread = threading.Thread(
+            target=_loop, name="torchft-policy", daemon=True
+        )
+        self._policy_thread.start()
+
+    def _policy_drain(self) -> "List[dict]":
+        raw = _take_str(
+            self._lib, self._lib.tft_lighthouse_drain_events(self._handle)
+        )
+        return json.loads(raw or "[]")
+
+    def set_policy(self, frame: dict) -> None:
+        """Publish a policy frame onto heartbeat/agg_tick replies (``{}``
+        clears it — the kill switch)."""
+        err = ctypes.c_char_p()
+        status = self._lib.tft_lighthouse_set_policy(
+            self._handle, json.dumps(frame).encode(), ctypes.byref(err)
+        )
+        _raise_for_status(
+            status, _take_str(self._lib, err), "set_policy failed"
+        )
+
+    def policy(self) -> dict:
+        """The currently published policy frame (``{}`` when none)."""
+        return json.loads(
+            _take_str(self._lib, self._lib.tft_lighthouse_policy(self._handle))
+            or "{}"
+        )
+
+    def retune_health(self, partial: dict) -> dict:
+        """Live-merge partial HealthOpts over the running ledger (policy
+        enforce mode tightening/widening eject thresholds). Returns the
+        resulting opts."""
+        out = ctypes.c_char_p()
+        err = ctypes.c_char_p()
+        status = self._lib.tft_lighthouse_retune_health(
+            self._handle, json.dumps(partial).encode(),
+            ctypes.byref(out), ctypes.byref(err),
+        )
+        out_s = _take_str(self._lib, out)
+        _raise_for_status(
+            status, _take_str(self._lib, err), "retune_health failed"
+        )
+        return json.loads(out_s or "{}")
 
     def address(self) -> str:
         return _take_str(self._lib, self._lib.tft_lighthouse_address(self._handle))
@@ -419,6 +540,13 @@ class LighthouseServer:
         )
 
     def shutdown(self) -> None:
+        if self._policy_stop is not None:
+            self._policy_stop.set()
+            if self._policy_thread is not None:
+                self._policy_thread.join(timeout=5.0)
+            self._policy_stop = None
+            self._policy_thread = None
+            self.policy_controller = None
         if self.serve_registry is not None:
             self.serve_registry.shutdown()
             self.serve_registry = None
@@ -571,6 +699,17 @@ class ManagerServer:
         until the first beat round-trips."""
         return json.loads(
             _take_str(self._lib, self._lib.tft_manager_health(self._handle))
+            or "{}"
+        )
+
+    def policy(self) -> dict:
+        """The latest adaptive-policy frame carried on a heartbeat reply
+        (directly from the root, or fanned out by the pod aggregator):
+        ``{"policy_seq", "mode", "knob_overrides", "active_rules"}``.
+        ``{}`` until a frame arrives. The Manager polls this at its
+        quorum safe point; the beat loop never interprets it."""
+        return json.loads(
+            _take_str(self._lib, self._lib.tft_manager_policy(self._handle))
             or "{}"
         )
 
@@ -1083,18 +1222,27 @@ def health_replay(script: list, opts: dict) -> dict:
 
 
 def history_replay(jsonl_text: str) -> dict:
-    """Parse a recorded-history JSONL (content, not a path) through the
-    NATIVE read path; returns ``{"events": [...], "summary": {...}}``.
+    """Parse a recorded-history JSONL through the NATIVE read path;
+    returns ``{"events": [...], "summary": {...}}``.
+
+    Accepts content or a path (plain or gzip'd) — both are funnelled
+    through :func:`torchft_tpu.tracing.load_history`, the single loader
+    shared with the ``trace history`` and ``policy replay`` CLIs, so the
+    entry points can't drift apart again.
 
     Parity hook for tests: torchft_tpu.tracing.history_fold carries the
     canonical Python fold and tests pin the native summary to it (same
     convention as :func:`health_replay`).
     """
+    from torchft_tpu.tracing import load_history
+
+    events = load_history(jsonl_text)
+    normalized = "\n".join(json.dumps(e) for e in events)
     lib = _load()
     result = ctypes.c_char_p()
     err = ctypes.c_char_p()
     status = lib.tft_history_replay(
-        jsonl_text.encode(), ctypes.byref(result), ctypes.byref(err)
+        normalized.encode(), ctypes.byref(result), ctypes.byref(err)
     )
     err_s = _take_str(lib, err)
     result_s = _take_str(lib, result)

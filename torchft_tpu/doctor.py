@@ -850,6 +850,57 @@ def check_tuning_env() -> Result:
     return True, f"{checked} tuning knob(s) registered, {n_set} set, all parse"
 
 
+def check_policy_env() -> Result:
+    """``TORCHFT_POLICY*`` sanity plus a loopback observe probe: the mode
+    names a known member, the numeric knobs parse, the spec (builtin or
+    the ``TORCHFT_POLICY_SPEC`` file) loads and validates, and a
+    throwaway engine in observe mode folds a synthetic churn burst into a
+    well-formed frame — the exact fold/evaluate pipeline a lighthouse
+    runs live, so a bad spec fails here instead of at fleet start."""
+    from torchft_tpu import knobs
+    from torchft_tpu.policy import POLICY_MODES, PolicyEngine, PolicySpec
+
+    mode = os.environ.get("TORCHFT_POLICY", "").strip() or "off"
+    if mode not in POLICY_MODES:
+        return False, (
+            f"TORCHFT_POLICY={mode!r} invalid: pick one of "
+            f"{'/'.join(POLICY_MODES)}"
+        )
+    try:
+        knobs.env_float("TORCHFT_POLICY_INTERVAL_S", 5.0)
+        window_s = knobs.env_float("TORCHFT_POLICY_WINDOW_S", 300.0)
+        knobs.env_int("TORCHFT_POLICY_RING", 4096)
+        knobs.env_int("TORCHFT_SYNC_EVERY", 0)
+    except ValueError as e:
+        return False, f"TORCHFT_POLICY_* numeric knob invalid: {e}"
+    spec_src = os.environ.get("TORCHFT_POLICY_SPEC", "").strip() or "builtin"
+    try:
+        spec = PolicySpec.load(spec_src)
+    except (ValueError, OSError, KeyError) as e:
+        return False, f"policy spec {spec_src!r} failed to load: {e}"
+    try:
+        # loopback observe probe on synthetic history (no lighthouse, no
+        # wall clock): a hot churn burst must fold and evaluate cleanly
+        from torchft_tpu._test.event_injector import churn_burst
+
+        engine = PolicyEngine(spec, mode="observe", window_s=window_s)
+        engine.feed(churn_burst(8, period_s=5.0))
+        frame = engine.evaluate()
+        if "policy_seq" not in frame:
+            raise ValueError(f"malformed frame: {frame!r}")
+    except Exception as e:  # noqa: BLE001 — probe failure is the finding
+        return False, f"observe probe failed on spec {spec_src!r}: {e}"
+    if mode == "off":
+        return True, (
+            f"policy off (byte-identical path); spec {spec_src!r} "
+            f"validates ({len(spec.rules)} rule(s)) and probes clean"
+        )
+    return True, (
+        f"policy {mode}: spec {spec_src!r} ({len(spec.rules)} rule(s)) "
+        f"probed clean, frame seq={frame['policy_seq']}"
+    )
+
+
 def check_fleetlint() -> Result:
     """In-process fleetlint env-contract run: every TORCHFT_* read in the
     package is registered/documented/doctored, and no finding beyond the
@@ -892,6 +943,7 @@ CHECKS: List[Tuple[str, Callable[[], Result]]] = [
     ("redundancy-env", check_redundancy_env),
     ("degrade-env", check_degrade_env),
     ("trace-env", check_trace_env),
+    ("policy-env", check_policy_env),
     ("tuning-env", check_tuning_env),
     ("fleetlint", check_fleetlint),
     ("health-http", check_health_endpoint),

@@ -21,9 +21,11 @@ becoming a silent tribal-knowledge knob.
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 from dataclasses import dataclass
-from typing import Callable, Dict, Optional, TypeVar
+from typing import Callable, Dict, Iterator, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -180,6 +182,27 @@ REGISTRY: Dict[str, Knob] = {
            "redundancy-env", "Shard generations retained per owner in each store."),
         _k("TORCHFT_POD", "str", "", "operations.md#running-a-fleet", "tuning-env",
            "Placement pod identity (defaults to the aggregator-derived pod)."),
+        # ------------------------------------------------------ policy plane
+        _k("TORCHFT_POLICY", "enum(off|observe|enforce)", "off",
+           "operations.md#adaptive-policies", "policy-env",
+           "Adaptive policy engine mode: off = byte-identical legacy"
+           " behavior, observe = log would-be actions, enforce = apply."),
+        _k("TORCHFT_POLICY_SPEC", "str", "builtin",
+           "operations.md#adaptive-policies", "policy-env",
+           "PolicySpec source: 'builtin' or a path to a PolicySpec JSON."),
+        _k("TORCHFT_POLICY_INTERVAL_S", "float", "5",
+           "operations.md#adaptive-policies", "policy-env",
+           "Engine evaluation cadence in seconds (fold + rule pass)."),
+        _k("TORCHFT_POLICY_WINDOW_S", "float", "300",
+           "operations.md#adaptive-policies", "policy-env",
+           "Rolling window the fleet signals (MTBF, churn, ...) cover."),
+        _k("TORCHFT_POLICY_RING", "int", "4096",
+           "operations.md#adaptive-policies", "policy-env",
+           "Lighthouse in-memory event-ring capacity feeding the engine."),
+        _k("TORCHFT_SYNC_EVERY", "int", "0",
+           "operations.md#adaptive-policies", "policy-env",
+           "LocalSGD/DiLoCo sync_every override (> 0 wins over the"
+           " constructor argument; the policy plane retargets it live)."),
         # ---------------------------------------------------- degrade plane
         _k("TORCHFT_DEGRADE", "enum(off|on)", "off",
            "operations.md#degraded-replicas", "degrade-env",
@@ -219,16 +242,81 @@ def all_knobs() -> Dict[str, Knob]:
     return dict(REGISTRY)
 
 
+# ---------------------------------------------------------------------------
+# Override layer (policy plane). The adaptive policy engine retargets knobs
+# at the Manager's quorum safe point by installing string values here;
+# every read funnelled through env_raw sees an override before the process
+# environment, so the central registry stays the single source of truth
+# fleetlint's env-contract checks hang off — an override can only name a
+# registered knob. Overrides are process-local and never mutate os.environ
+# (a policy rollback must not leave residue in the environment).
+_overrides: Dict[str, str] = {}
+_overrides_mu = threading.Lock()
+
+
+def set_override(name: str, value: Optional[str]) -> None:
+    """Install (or, with ``None``, clear) one override. The name must be
+    registered; values are strings exactly as an env var would carry."""
+    if name not in REGISTRY:
+        raise KeyError(
+            f"{name} is not in the TORCHFT knob registry — overrides can "
+            "only retarget registered knobs"
+        )
+    with _overrides_mu:
+        if value is None:
+            _overrides.pop(name, None)
+        else:
+            _overrides[name] = str(value)
+
+
+def get_overrides() -> Dict[str, str]:
+    """Snapshot of the active override set (name -> value)."""
+    with _overrides_mu:
+        return dict(_overrides)
+
+
+def clear_overrides() -> None:
+    """Drop every active override (the policy kill switch)."""
+    with _overrides_mu:
+        _overrides.clear()
+
+
+@contextlib.contextmanager
+def override_scope(values: Dict[str, str]) -> Iterator[None]:
+    """Scoped knob overrides: install ``values`` on entry, restore the
+    previous override state on exit. Nesting composes (inner scopes win
+    while active). Unregistered names raise before anything is changed."""
+    for name in values:
+        if name not in REGISTRY:
+            raise KeyError(
+                f"{name} is not in the TORCHFT knob registry — overrides "
+                "can only retarget registered knobs"
+            )
+    with _overrides_mu:
+        saved = dict(_overrides)
+        _overrides.update({k: str(v) for k, v in values.items()})
+    try:
+        yield
+    finally:
+        with _overrides_mu:
+            _overrides.clear()
+            _overrides.update(saved)
+
+
 def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
     """``os.environ.get`` gated on registration: reading a knob that was
     never declared is a contract bug, surfaced here instead of shipping as
-    an undocumented env var."""
+    an undocumented env var. Active policy overrides (``override_scope``)
+    take precedence over the process environment."""
     if name not in REGISTRY:
         raise KeyError(
             f"{name} is not in the TORCHFT knob registry "
             "(torchft_tpu/knobs.py) — register it with a type, default, "
             "doc anchor, and doctor coverage before reading it"
         )
+    with _overrides_mu:
+        if name in _overrides:
+            return _overrides[name]
     return os.environ.get(name, default)
 
 

@@ -70,6 +70,17 @@ def main(argv: "list[str] | None" = None) -> None:
         "(docs/operations.md); point replicas at it via "
         "TORCHFT_REDUNDANCY_DIRECTORY",
     )
+    parser.add_argument(
+        "--policy",
+        default=None,
+        metavar="PATH|builtin",
+        help="attach the adaptive policy engine: a PolicySpec JSON file or "
+        "'builtin' (docs/operations.md#adaptive-policies). Frames ride the "
+        "existing heartbeat/agg_tick replies; what managers DO with them is "
+        "governed by TORCHFT_POLICY (off|observe|enforce, default off). "
+        "Replay candidates first: "
+        "`python -m torchft_tpu.policy replay --history F --policy A B`",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -83,8 +94,15 @@ def main(argv: "list[str] | None" = None) -> None:
         serve_registry=args.serve_registry,
         serve_drain_on=args.serve_drain_on,
         redundancy_directory=args.redundancy_directory,
+        policy=args.policy,
     )
     logging.info("lighthouse listening at %s", server.address())
+    if server.policy_controller is not None:
+        logging.info(
+            "policy engine attached (spec=%s mode=%s)",
+            args.policy,
+            server.policy_mode,
+        )
     if server.serve_registry is not None:
         logging.info(
             "snapshot registry serving at %s (epoch %s)",

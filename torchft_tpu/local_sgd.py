@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from torchft_tpu import knobs
 from torchft_tpu.manager import Manager
 from torchft_tpu.process_group import ReduceOp
 from torchft_tpu.work import Work
@@ -101,8 +102,18 @@ class LocalSGD:
     ) -> None:
         assert sync_every >= 1
         self._manager = manager
-        self._sync_every = sync_every
+        # TORCHFT_SYNC_EVERY > 0 (env or policy override) beats the
+        # constructor arg, so the same launch script can be retargeted
+        # without a code change; 0 (the default) means "use the arg".
+        env_sync = knobs.env_int("TORCHFT_SYNC_EVERY", 0)
+        self._sync_every = env_sync if env_sync > 0 else sync_every
+        self._arg_sync_every = self._sync_every
         self._local_step = 0
+        # test doubles and minimal manager stand-ins may not carry the
+        # policy surface — live retargeting is an optional capability
+        register = getattr(manager, "register_policy_adjuster", None)
+        if register is not None:
+            register("TORCHFT_SYNC_EVERY", self._policy_set_sync_every)
         # get_params only matters for sync-quorum managers: with async quorum
         # a healing replica is non-participating, so Manager.allreduce zeros
         # its contribution and the averaged result it adopts is built from
@@ -119,6 +130,23 @@ class LocalSGD:
 
     def _load_state(self, sd: Dict[str, Any]) -> None:
         self._backup = sd["backup"]
+
+    @property
+    def sync_every(self) -> int:
+        return self._sync_every
+
+    def set_sync_every(self, sync_every: int) -> None:
+        """Live-retarget the sync cadence. Safe at any inner step: a
+        longer cadence simply pushes the next boundary out; a shorter one
+        syncs on the next ``step`` whose counter has already crossed it."""
+        assert sync_every >= 1
+        self._sync_every = sync_every
+
+    def _policy_set_sync_every(self, value: Optional[str]) -> None:
+        if value is None:
+            self.set_sync_every(self._arg_sync_every)
+        else:
+            self.set_sync_every(max(1, int(value)))
 
     def step(self, params: Any) -> Any:
         """Count an inner step; on the sync boundary average params across
@@ -447,6 +475,12 @@ class DiLoCo:
 
         env_bucketization = knobs.env_bool("TORCHFT_USE_BUCKETIZATION")
         use_bucketization = env_bucketization or bool(use_bucketization)
+        # TORCHFT_SYNC_EVERY > 0 (env or policy override) replaces the
+        # constructor's total cadence; it goes through the same
+        # divisibility validation below, so a bad value fails fast.
+        env_sync = knobs.env_int("TORCHFT_SYNC_EVERY", 0)
+        if env_sync > 0:
+            sync_every = env_sync
         bucket_cap_bytes = (
             bucket_cap_mb * 1024 * 1024
             if bucket_cap_mb is not None
@@ -492,6 +526,42 @@ class DiLoCo:
             )
             for i, idxs in enumerate(fragment_partition)
         ]
+        self._arg_sync_every = self._sync_every
+        self._pending_sync_every: Optional[int] = None
+        # same optional-capability contract as LocalSGD above
+        register = getattr(manager, "register_policy_adjuster", None)
+        if register is not None:
+            register("TORCHFT_SYNC_EVERY", self._policy_set_sync_every)
+
+    @property
+    def sync_every(self) -> int:
+        """Per-fragment cycle length currently in force."""
+        return self._sync_every
+
+    def set_sync_every(self, sync_every: int) -> None:
+        """Queue a live retarget of the total sync cadence. Validated
+        like the constructor (positive multiple of num_fragments, longer
+        than the fragment delay); applied at the next cycle boundary so
+        an in-flight prepare/perform pair is never split."""
+        n = len(self._fragments)
+        if sync_every < n or sync_every % n != 0:
+            raise ValueError(
+                "sync_every must be a positive multiple of num_fragments"
+            )
+        per = sync_every // n
+        if self._delay >= per:
+            raise ValueError("fragment must sync before it is reduced again")
+        self._pending_sync_every = per
+
+    def _policy_set_sync_every(self, value: Optional[str]) -> None:
+        if value is None:
+            self._pending_sync_every = self._arg_sync_every
+            return
+        # policy values are advisory — clamp into the legal range instead
+        # of raising at the quorum safe point
+        n = len(self._fragments)
+        per = max(int(value) // n, self._delay + 1, 1)
+        self._pending_sync_every = per
 
     def _current_fragment(self) -> int:
         # All replicas pick the fragment from the shared manager step so they
@@ -503,6 +573,12 @@ class DiLoCo:
         """Advance one inner step; returns params (synced on boundaries)."""
         import jax
 
+        # cycle boundary: a policy retarget queued mid-cycle lands here,
+        # where the equality-based prepare/perform triggers below cannot
+        # be skipped over by a shrinking cadence
+        if self._local_step == 0 and self._pending_sync_every is not None:
+            self._sync_every = self._pending_sync_every
+            self._pending_sync_every = None
         self._local_step += 1
 
         leaves, treedef = jax.tree_util.tree_flatten(params)

@@ -38,7 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
-from torchft_tpu import bucketing
+from torchft_tpu import bucketing, knobs
 from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport, RWLock
 from torchft_tpu.coordination import (
     KvClient,
@@ -52,6 +52,7 @@ from torchft_tpu.observability import (
     COMMIT_EVENTS,
     HEALTH_EVENTS,
     METRICS_PORT_ENV,
+    POLICY_EVENTS,
     TIMING_EVENTS,
     MetricsRegistry,
     MetricsServer,
@@ -139,6 +140,11 @@ _COUNTER_TIMINGS = frozenset(
         # full-degree restores
         "degrade_events",
         "restored_events",
+        # policy plane (_poll_policy_safe_point): frames enforced /
+        # observed at the quorum safe point (policy_seq stays a gauge —
+        # it is the latest frame version, not a count)
+        "policy_applies",
+        "policy_intents",
     }
 )
 
@@ -514,6 +520,25 @@ class Manager:
         # (docs/operations.md#degraded-replicas)
         for _counter in ("degrade_events", "restored_events"):
             self._timings[_counter] = 0.0
+        # policy plane (docs/operations.md#adaptive-policies): frames are
+        # polled off the heartbeat mirror at the start_quorum safe point.
+        # policy_seq = last frame version seen; policy_intents counts
+        # observe-mode would-be applications, policy_applies enforce-mode
+        # real ones. TORCHFT_POLICY=off skips the poll entirely (the
+        # byte-identical contract pinned by test_policy_off_byte_identical).
+        for _counter in ("policy_seq", "policy_applies", "policy_intents"):
+            self._timings[_counter] = 0.0
+        self._policy_mode = knobs.env_str("TORCHFT_POLICY", "off").strip() or "off"
+        self._policy_seq_seen = -1
+        # live knob adjusters: knob name -> setter, registered by the
+        # planes that can retarget without a restart (LocalSGD/DiLoCo
+        # sync_every, redundancy staging interval). Applied in enforce
+        # mode at the safe point, after knobs.set_override.
+        self._policy_adjusters: Dict[str, Callable[[str], None]] = {}
+        # the override set THIS manager last applied in enforce mode —
+        # the diff base for reverts (knobs' global layer is shared
+        # across managers in-process, so it can't be the baseline)
+        self._policy_overrides_applied: Dict[str, str] = {}
         self._telemetry_transform: Optional[
             Callable[[Dict[str, Any]], Dict[str, Any]]
         ] = None
@@ -652,6 +677,19 @@ class Manager:
             )
             self._redundancy_cfg = None
             self._shard_stager = None
+        if self._shard_stager is not None:
+            # policy plane can retune staging cadence / parity count live;
+            # both take effect at the next maybe_stage (per-commit gate)
+            self._policy_red_defaults = (
+                self._redundancy_cfg.interval,
+                self._redundancy_cfg.m,
+            )
+            self.register_policy_adjuster(
+                "TORCHFT_REDUNDANCY_INTERVAL", self._policy_set_red_interval
+            )
+            self.register_policy_adjuster(
+                "TORCHFT_REDUNDANCY_M", self._policy_set_red_m
+            )
 
         # degrade plane (parallel/degrade.py, docs/operations.md
         # #degraded-replicas): with TORCHFT_DEGRADE=on a dead chip inside
@@ -779,6 +817,12 @@ class Manager:
         if self._degrade_cfg is not None:
             self._commit_pending_degrade()
 
+        # adaptive policy plane: a frame that arrived on the heartbeat
+        # mirror lands here — the quorum safe point — never mid-step.
+        # TORCHFT_POLICY=off skips the poll entirely (byte-identical).
+        if self._policy_mode != "off":
+            self._poll_policy_safe_point()
+
         self._quorum_future = self._executor.submit(
             self._async_quorum,
             allow_heal=allow_heal,
@@ -801,6 +845,150 @@ class Manager:
         assert self._quorum_future is not None, "must call start_quorum first"
         with trace_span("torchft::manager::wait_quorum"):
             self._quorum_future.result()
+
+    # ------------------------------------------------------------- policy
+    def register_policy_adjuster(
+        self, knob: str, fn: "Callable[[Optional[str]], None]"
+    ) -> None:
+        """Register a live setter for one knob (LocalSGD/DiLoCo register
+        their ``sync_every`` here, redundancy its staging interval). In
+        enforce mode the setter runs at the quorum safe point with the
+        frame's string value, or ``None`` when the override is released
+        (hysteresis relaxed) — the plane restores its construction-time
+        value. Last registration per knob wins."""
+        self._policy_adjusters[knob] = fn
+
+    def policy_status(self) -> Dict[str, Any]:
+        """Operator view of the policy plane on this replica: mode, last
+        frame seq applied/observed, and the override set in force."""
+        with self._metrics_lock:
+            seq = int(self._timings.get("policy_seq", 0.0))
+        return {
+            "mode": self._policy_mode,
+            "policy_seq": seq,
+            "overrides": knobs.get_overrides(),
+            "adjusters": sorted(self._policy_adjusters),
+        }
+
+    def _policy_set_red_interval(self, value: Optional[str]) -> None:
+        cfg = self._redundancy_cfg
+        if cfg is None:
+            return
+        if value is None:
+            cfg.interval = self._policy_red_defaults[0]
+        else:
+            cfg.interval = max(1, int(value))
+
+    def _policy_set_red_m(self, value: Optional[str]) -> None:
+        cfg = self._redundancy_cfg
+        if cfg is None:
+            return
+        if value is None:
+            m = self._policy_red_defaults[1]
+        else:
+            # keep within the GF(256) shard limit the constructor enforces
+            m = min(max(1, int(value)), 255 - cfg.k)
+        cfg.m = m
+
+    def _poll_policy_safe_point(self) -> None:
+        """Poll the heartbeat mirror for a new policy frame and act on it.
+
+        Runs only from start_quorum (the safe point: no collective in
+        flight, the previous configure committed) and only when
+        TORCHFT_POLICY != off. Observe mode records the would-be action
+        everywhere an operator looks (timings, torchft_policy stream,
+        flight recorder, trace instant) without touching a knob; enforce
+        additionally installs the overrides through the central registry
+        layer and runs the registered live adjusters. Must never raise —
+        a malformed frame degrades to a logged warning, not a lost step."""
+        try:
+            frame = self._manager.policy() if self._manager is not None else {}
+        except Exception:  # noqa: BLE001 — mirror read must not cost a step
+            return
+        if not frame:
+            return
+        try:
+            seq = int(frame.get("policy_seq", 0))
+            if seq <= self._policy_seq_seen:
+                return
+            self._policy_seq_seen = seq
+            overrides = {
+                str(k): str(v)
+                for k, v in (frame.get("knob_overrides") or {}).items()
+                if knobs.is_registered(str(k))
+            }
+            enforce = (
+                self._policy_mode == "enforce"
+                and str(frame.get("mode", "")) == "enforce"
+            )
+            with self._metrics_lock:
+                self._timings["policy_seq"] = float(seq)
+                if enforce:
+                    self._timings["policy_applies"] += 1.0
+                else:
+                    self._timings["policy_intents"] += 1.0
+            action = "apply" if enforce else "intent"
+            self._logger.info(
+                f"policy: {action} seq={seq} overrides={overrides} "
+                f"rules={frame.get('active_rules', [])}"
+            )
+            emit_event_async(
+                POLICY_EVENTS,
+                replica_id=self._replica_id,
+                group_rank=self._group_rank,
+                step=self._step,
+                quorum_id=self._quorum_id,
+                policy_seq=seq,
+                action=action,
+                overrides=overrides,
+                active_rules=list(frame.get("active_rules", [])),
+            )
+            from torchft_tpu.flight_recorder import recorder
+
+            recorder.record(
+                "policy_" + action,
+                policy_seq=seq,
+                overrides=overrides,
+                step=self._step,
+                replica=self._replica_id,
+            )
+            self._tracer.instant(
+                "policy_" + action, cat="policy", policy_seq=seq
+            )
+            if not enforce:
+                return
+            # Enforce: diff against what THIS manager applied from the
+            # predecessor frame so a released rule's knob reverts
+            # (hysteresis relaxation must undo, not just stop
+            # re-applying). The diff base is per-manager, not the global
+            # override layer: with several managers in one process (test
+            # fleets) whichever polls a frame first mutates the shared
+            # layer, and diffing against it would skip the others'
+            # adjuster restore calls.
+            previous = self._policy_overrides_applied
+            for name in previous:
+                if name not in overrides:
+                    knobs.set_override(name, None)
+                    adjuster = self._policy_adjusters.get(name)
+                    if adjuster is not None:
+                        adjuster(None)
+            for name, value in overrides.items():
+                knobs.set_override(name, value)
+                adjuster = self._policy_adjusters.get(name)
+                if adjuster is not None:
+                    adjuster(value)
+            # Manager-owned knob: the wire codec retargets in place (the
+            # next streamed allreduce picks it up; error-feedback
+            # residuals are keyed per plan and survive the switch).
+            if "TORCHFT_COMPRESS" in overrides:
+                self._compress = resolve_compress_mode(
+                    overrides["TORCHFT_COMPRESS"]
+                )
+            elif "TORCHFT_COMPRESS" in previous:
+                self._compress = resolve_compress_mode(None)
+            self._policy_overrides_applied = dict(overrides)
+        except Exception:  # noqa: BLE001
+            self._logger.exception("policy frame handling failed (ignored)")
 
     def _sync_device_world(self) -> None:
         """Re-land registered user state on the live jax backend after the
@@ -2814,12 +3002,18 @@ class Manager:
                     )
                     heal_attempts = self._timings.get("heal_attempts", 0.0)
                     rpc_retries = self._timings.get("rpc_retries", 0.0)
+                    reroutes = self._timings.get("collective_reroute", 0.0)
+                    crc_fails = self._timings.get("chunk_crc_failures", 0.0)
                 telemetry: Dict[str, Any] = {
                     "step": self._step,
                     "step_s": now - last,
                     "wire_s": wire_s,
                     "heal_attempts": heal_attempts,
                     "rpc_retries": rpc_retries,
+                    # cumulative link-fault counters: the policy plane's
+                    # link_quality signal differences these per replica
+                    "collective_reroute": reroutes,
+                    "chunk_crc_failures": crc_fails,
                 }
                 if self._degrade_cfg is not None:
                     # degrade plane: self-report capacity so the ledger

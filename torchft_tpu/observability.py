@@ -61,6 +61,10 @@ ALLREDUCE_PIPELINE_PHASE = "allreduce_pipeline"
 # the Manager observes them in heartbeat health summaries — the replica's
 # own view of the lighthouse health ledger (healthwatch.py)
 HEALTH_EVENTS = "torchft_health"
+# adaptive policy plane (policy.py): frame arrivals and observe/enforce
+# actions at the Manager's quorum safe point — policy_seq, mode, the
+# override set, and which rules were active when it was built
+POLICY_EVENTS = "torchft_policy"
 
 _otel_providers: Dict[str, Any] = {}
 
@@ -166,6 +170,10 @@ def log_timing_event(**fields: Any) -> None:
 
 def log_health_event(**fields: Any) -> None:
     get_event_logger(HEALTH_EVENTS).log(**fields)
+
+
+def log_policy_event(**fields: Any) -> None:
+    get_event_logger(POLICY_EVENTS).log(**fields)
 
 
 class EventDrain:

@@ -198,7 +198,12 @@ def resolve_compress_mode(mode: Optional[str] = None) -> str:
     compress-env check funnels through here so the CLI and the Manager
     reject identically.
     """
-    raw = os.environ.get(COMPRESS_ENV)
+    # knobs.env_raw (not os.environ) so a policy-plane override on
+    # TORCHFT_COMPRESS retargets the codec live, and still beats a
+    # stale ambient env var the operator exported at launch.
+    from torchft_tpu import knobs
+
+    raw = knobs.env_raw(COMPRESS_ENV)
     if raw is not None:
         value = raw.strip().lower() or "off"
     elif mode is not None:

@@ -1,0 +1,732 @@
+"""Adaptive fault-tolerance policy plane.
+
+Every FT knob in the package is a static env var, yet the fleet already
+emits the signals needed to set them: heartbeat telemetry, healthwatch
+states, quorum churn and reroute/CRC counters all land in the lighthouse's
+recorded-history event stream. This module closes the loop (ROADMAP item
+1, after Chameleon's real-time policy selection, with PHOENIX motivating
+failure-frequency-driven checkpoint/standby cadence):
+
+- :func:`fold_signals` folds history-style events into rolling fleet
+  signals — MTBF, churn rate, straggler density, effective link quality.
+  It is THE shared code path: the live engine folds events drained from
+  the lighthouse's in-memory ring and the offline replay scorer folds the
+  same events read back from a ``--history`` file, so a policy scored
+  offline behaves identically online (pinned by a parity test).
+- :class:`PolicySpec` is the declarative rule set: signal -> condition ->
+  knob-set actions, with hysteresis bands (a rule activates at
+  ``threshold`` and releases only past ``release``) and per-knob min/max
+  clamps so a runaway policy cannot push a knob outside its safe range.
+- :class:`PolicyEngine` evaluates a spec over folded signals and emits
+  versioned ``(policy_seq, knob_overrides)`` frames. Frames ride the
+  EXISTING wire: the lighthouse piggybacks the newest frame on heartbeat
+  and agg_tick replies (zero new RPC methods); managers poll it at their
+  quorum safe point and apply through :func:`knobs.override_scope`'s
+  registry layer.
+- :class:`PolicyController` is the thin lighthouse-side loop gluing the
+  engine to the native handle (drain ring -> fold -> publish frame, and
+  in enforce mode retune the health ledger live).
+- ``python -m torchft_tpu.policy replay --history FILE --policy A.json
+  B.json`` scores candidate specs against a recorded run (discarded
+  steps, eject/readmit flapping, projected wire bytes, recovery
+  exposure) so policies are evaluated on real history before enforcement.
+
+Modes (``TORCHFT_POLICY``): ``off`` (default) is byte-identical to the
+pre-policy package — no engine, no frames, nothing polled; ``observe``
+distributes frames and managers log would-be actions without applying;
+``enforce`` applies them. Observe-first is the rollout contract: replay
+candidates offline, observe the winner live, then enforce.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchft_tpu import knobs
+
+__all__ = [
+    "Signals",
+    "fold_signals",
+    "PolicyRule",
+    "PolicySpec",
+    "PolicyEngine",
+    "PolicyController",
+    "score_policy",
+    "rank_policies",
+    "POLICY_MODES",
+]
+
+POLICY_MODES = ("off", "observe", "enforce")
+
+# Signal names a rule may condition on (the fold's output fields).
+SIGNALS = ("mtbf_s", "churn_per_min", "straggler_density", "link_quality")
+
+# Telemetry counters treated as link-fault evidence (cumulative; the fold
+# takes per-replica deltas so re-sent payloads cost nothing).
+_LINK_FAULT_KEYS = ("collective_reroute", "chunk_crc_failures", "rpc_retries")
+
+
+# ---------------------------------------------------------------- signals
+@dataclass
+class Signals:
+    """Rolling fleet signals folded from history-style events."""
+
+    mtbf_s: float  # mean seconds between failures (window span if none)
+    churn_per_min: float  # membership deltas + ejects/readmits per minute
+    straggler_density: float  # fraction of seen replicas warned/ejected
+    link_quality: float  # 1 - link faults per telemetry step, floored at 0
+    window_s: float  # window the fold covered
+    events: int  # events inside the window
+    replicas: int  # distinct replicas seen inside the window
+    failures: int  # failure-shaped events (ejects + quorum departures)
+
+    def to_dict(self) -> Dict[str, float]:
+        return {
+            "mtbf_s": round(self.mtbf_s, 3),
+            "churn_per_min": round(self.churn_per_min, 4),
+            "straggler_density": round(self.straggler_density, 4),
+            "link_quality": round(self.link_quality, 4),
+            "window_s": self.window_s,
+            "events": self.events,
+            "replicas": self.replicas,
+            "failures": self.failures,
+        }
+
+
+def fold_signals(
+    events: List[Dict[str, Any]],
+    window_s: float,
+    now_ms: Optional[int] = None,
+) -> Signals:
+    """Fold history-style events into :class:`Signals`.
+
+    Deterministic and event-time driven: ``now_ms`` defaults to the newest
+    event's ``ts_ms`` so the same events always fold to the same signals
+    regardless of wall clock — the property the live-vs-replay parity test
+    pins. This one function IS the shared live/replay code path; do not
+    fork a second extractor.
+    """
+    if now_ms is None:
+        now_ms = max((int(e.get("ts_ms", 0)) for e in events), default=0)
+    lo_ms = now_ms - int(window_s * 1000.0)
+    window = [
+        e for e in events if lo_ms <= int(e.get("ts_ms", now_ms)) <= now_ms
+    ]
+    window.sort(key=lambda e: (int(e.get("ts_ms", 0)), int(e.get("seq", 0))))
+
+    replicas = set()
+    failure_ts: List[int] = []
+    churn_units = 0
+    flagged = set()  # replicas warned/ejected in the window
+    prev_participants: Optional[set] = None
+    # link fault deltas from cumulative telemetry counters, per replica
+    last_counter: Dict[str, float] = {}
+    fault_delta = 0.0
+    telemetry_steps = 0
+
+    for e in window:
+        kind = str(e.get("kind", ""))
+        ts = int(e.get("ts_ms", now_ms))
+        rid = str(e.get("replica_id", "")) if "replica_id" in e else ""
+        if rid:
+            replicas.add(rid)
+        if kind == "quorum":
+            parts = {str(r) for r in e.get("participants", [])}
+            replicas.update(parts)
+            if prev_participants is not None:
+                departed = prev_participants - parts
+                joined = parts - prev_participants
+                churn_units += len(departed) + len(joined)
+                for _ in departed:
+                    failure_ts.append(ts)
+            prev_participants = parts
+        elif kind == "eject":
+            failure_ts.append(ts)
+            churn_units += 1
+            flagged.add(rid)
+        elif kind == "readmit":
+            churn_units += 1
+        elif kind == "straggler_warn":
+            flagged.add(rid)
+        elif kind == "telemetry":
+            telemetry_steps += 1
+            t = e.get("telemetry", {}) or {}
+            total = sum(float(t.get(k, 0.0)) for k in _LINK_FAULT_KEYS)
+            prev = last_counter.get(rid)
+            if prev is not None and total >= prev:
+                fault_delta += total - prev
+            last_counter[rid] = total
+
+    span_s = max((now_ms - lo_ms) / 1000.0, 1e-9)
+    n_failures = len(failure_ts)
+    mtbf_s = span_s / n_failures if n_failures > 0 else span_s
+    churn_per_min = churn_units / (span_s / 60.0)
+    density = len(flagged) / len(replicas) if replicas else 0.0
+    quality = (
+        max(0.0, 1.0 - fault_delta / telemetry_steps)
+        if telemetry_steps > 0
+        else 1.0
+    )
+    return Signals(
+        mtbf_s=mtbf_s,
+        churn_per_min=churn_per_min,
+        straggler_density=min(density, 1.0),
+        link_quality=quality,
+        window_s=window_s,
+        events=len(window),
+        replicas=len(replicas),
+        failures=n_failures,
+    )
+
+
+# ------------------------------------------------------------------- spec
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+}
+
+
+@dataclass
+class PolicyRule:
+    """One declarative rule: ``signal op threshold`` -> knob actions.
+
+    Hysteresis: once active, the rule stays active until the signal
+    crosses ``release`` (which must sit on the opposite side of
+    ``threshold``), so a signal oscillating around the threshold cannot
+    flap the fleet's knobs every evaluation."""
+
+    name: str
+    signal: str
+    op: str
+    threshold: float
+    release: float
+    actions: Dict[str, str]
+
+    def fires(self, value: float) -> bool:
+        return _OPS[self.op](value, self.threshold)
+
+    def releases(self, value: float) -> bool:
+        # release compares with the flipped operator around the release
+        # bound: a ">" rule deactivates when the value falls to/below it.
+        flipped = {"<": ">=", "<=": ">", ">": "<=", ">=": "<"}[self.op]
+        return _OPS[flipped](value, self.release)
+
+    def validate(self) -> None:
+        if self.signal not in SIGNALS:
+            raise ValueError(
+                f"rule {self.name!r}: unknown signal {self.signal!r} "
+                f"(have {SIGNALS})"
+            )
+        if self.op not in _OPS:
+            raise ValueError(f"rule {self.name!r}: unknown op {self.op!r}")
+        widened = (
+            self.release <= self.threshold
+            if self.op in (">", ">=")
+            else self.release >= self.threshold
+        )
+        if not widened:
+            raise ValueError(
+                f"rule {self.name!r}: release {self.release} must sit on "
+                f"the releasing side of threshold {self.threshold} for "
+                f"op {self.op!r} (hysteresis band)"
+            )
+        if not self.actions:
+            raise ValueError(f"rule {self.name!r}: no actions")
+        for knob in self.actions:
+            if not knobs.is_registered(knob):
+                raise ValueError(
+                    f"rule {self.name!r}: action targets unregistered "
+                    f"knob {knob!r} — the env contract is the source of "
+                    "truth; register it in torchft_tpu/knobs.py first"
+                )
+
+
+@dataclass
+class PolicySpec:
+    """A named rule set with per-knob clamps.
+
+    Rules are evaluated in order; when two active rules set the same knob
+    the LATER rule wins (list order is the priority order). Clamps bound
+    every numeric action value — the first line of the runaway-policy
+    runbook (docs/operations.md#adaptive-policies)."""
+
+    name: str
+    rules: List[PolicyRule]
+    clamps: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+
+    def validate(self) -> None:
+        seen = set()
+        for r in self.rules:
+            if r.name in seen:
+                raise ValueError(f"duplicate rule name {r.name!r}")
+            seen.add(r.name)
+            r.validate()
+        for knob, (lo, hi) in self.clamps.items():
+            if not knobs.is_registered(knob):
+                raise ValueError(f"clamp targets unregistered knob {knob!r}")
+            if lo > hi:
+                raise ValueError(f"clamp for {knob!r}: min {lo} > max {hi}")
+
+    def clamp(self, knob: str, value: str) -> str:
+        """Apply the knob's clamp to a numeric action value (non-numeric
+        values — enum knobs like TORCHFT_COMPRESS — pass through)."""
+        if knob not in self.clamps:
+            return value
+        try:
+            v = float(value)
+        except ValueError:
+            return value
+        lo, hi = self.clamps[knob]
+        clamped = min(max(v, lo), hi)
+        if clamped == int(clamped) and "." not in value:
+            return str(int(clamped))
+        return str(clamped)
+
+    @staticmethod
+    def from_json(obj: Dict[str, Any]) -> "PolicySpec":
+        rules = [
+            PolicyRule(
+                name=str(r["name"]),
+                signal=str(r["signal"]),
+                op=str(r["op"]),
+                threshold=float(r["threshold"]),
+                release=float(r["release"]),
+                actions={str(k): str(v) for k, v in r["actions"].items()},
+            )
+            for r in obj.get("rules", [])
+        ]
+        clamps = {
+            str(k): (float(v[0]), float(v[1]))
+            for k, v in obj.get("clamps", {}).items()
+        }
+        spec = PolicySpec(
+            name=str(obj.get("name", "unnamed")), rules=rules, clamps=clamps
+        )
+        spec.validate()
+        return spec
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "rules": [
+                {
+                    "name": r.name,
+                    "signal": r.signal,
+                    "op": r.op,
+                    "threshold": r.threshold,
+                    "release": r.release,
+                    "actions": dict(r.actions),
+                }
+                for r in self.rules
+            ],
+            "clamps": {k: list(v) for k, v in self.clamps.items()},
+        }
+
+    @staticmethod
+    def load(source: str) -> "PolicySpec":
+        """Resolve ``--policy PATH|builtin``."""
+        if source == "builtin":
+            return builtin_spec()
+        with open(source) as f:
+            return PolicySpec.from_json(json.load(f))
+
+
+def builtin_spec() -> PolicySpec:
+    """The shipped default: conservative adaptations with wide hysteresis.
+
+    - under churn, lengthen the LocalSGD/DiLoCo sync cadence (fewer sync
+      barriers exposed to failures) and widen the eject threshold (churny
+      fleets misattribute slowness);
+    - when calm, tighten the eject threshold (catch real stragglers);
+    - on flaky links, switch the wire codec to int8 (fewest bytes
+      re-sent per reroute/CRC refetch);
+    - when measured MTBF drops, stage redundancy shards every commit and
+      add a parity shard (PHOENIX: cadence follows failure frequency).
+    """
+    return PolicySpec(
+        name="builtin",
+        rules=[
+            PolicyRule(
+                name="calm-tighten-eject",
+                signal="churn_per_min",
+                op="<",
+                threshold=0.5,
+                release=2.0,
+                actions={"TORCHFT_HEALTH_EJECT_Z": "5.0"},
+            ),
+            PolicyRule(
+                name="churn-lengthen-sync",
+                signal="churn_per_min",
+                op=">",
+                threshold=6.0,
+                release=2.0,
+                actions={
+                    "TORCHFT_SYNC_EVERY": "64",
+                    "TORCHFT_HEALTH_EJECT_Z": "9.0",
+                },
+            ),
+            PolicyRule(
+                name="flaky-links-compress",
+                signal="link_quality",
+                op="<",
+                threshold=0.9,
+                release=0.97,
+                actions={"TORCHFT_COMPRESS": "int8"},
+            ),
+            PolicyRule(
+                name="low-mtbf-stage-often",
+                signal="mtbf_s",
+                op="<",
+                threshold=120.0,
+                release=300.0,
+                actions={
+                    "TORCHFT_REDUNDANCY_INTERVAL": "1",
+                    "TORCHFT_REDUNDANCY_M": "2",
+                },
+            ),
+        ],
+        clamps={
+            "TORCHFT_SYNC_EVERY": (1, 512),
+            "TORCHFT_HEALTH_EJECT_Z": (3.0, 12.0),
+            "TORCHFT_REDUNDANCY_INTERVAL": (1, 64),
+            "TORCHFT_REDUNDANCY_M": (1, 4),
+        },
+    )
+
+
+# ----------------------------------------------------------------- engine
+class PolicyEngine:
+    """Folds events, evaluates a spec with hysteresis, emits versioned
+    frames. Used verbatim by BOTH the live controller and the offline
+    scorer — that is the parity contract."""
+
+    def __init__(
+        self,
+        spec: PolicySpec,
+        mode: str = "observe",
+        window_s: float = 300.0,
+    ) -> None:
+        if mode not in POLICY_MODES:
+            raise ValueError(f"mode {mode!r} not in {POLICY_MODES}")
+        spec.validate()
+        self.spec = spec
+        self.mode = mode
+        self.window_s = window_s
+        self.policy_seq = 0
+        self.active: List[str] = []  # active rule names, spec order
+        self._events: List[Dict[str, Any]] = []
+        self._last_overrides: Dict[str, str] = {}
+        self.flips = 0  # activation-set changes (flap telemetry + scoring)
+
+    def feed(self, events: List[Dict[str, Any]]) -> None:
+        """Add freshly drained events; old ones are pruned on evaluate."""
+        self._events.extend(events)
+
+    def signals(self, now_ms: Optional[int] = None) -> Signals:
+        return fold_signals(self._events, self.window_s, now_ms)
+
+    def evaluate(self, now_ms: Optional[int] = None) -> Dict[str, Any]:
+        """One policy pass: fold -> hysteresis rule update -> frame.
+
+        ``policy_seq`` bumps only when the override set changes, so a
+        steady fleet re-distributes the same frame (managers dedup on
+        seq) and a changed one is applied exactly once per change."""
+        sig = fold_signals(self._events, self.window_s, now_ms)
+        # prune events that can no longer influence any window
+        if self._events:
+            horizon = (
+                max(int(e.get("ts_ms", 0)) for e in self._events)
+                - int(self.window_s * 2000.0)
+            )
+            self._events = [
+                e
+                for e in self._events
+                if int(e.get("ts_ms", horizon)) >= horizon
+            ]
+        active = set(self.active)
+        for rule in self.spec.rules:
+            value = getattr(sig, rule.signal)
+            if rule.name in active:
+                if rule.releases(value):
+                    active.discard(rule.name)
+            elif rule.fires(value):
+                active.add(rule.name)
+        ordered = [r.name for r in self.spec.rules if r.name in active]
+        if ordered != self.active:
+            self.flips += 1
+            self.active = ordered
+        overrides: Dict[str, str] = {}
+        for rule in self.spec.rules:
+            if rule.name not in active:
+                continue
+            for knob, value in rule.actions.items():
+                overrides[knob] = self.spec.clamp(knob, value)
+        if overrides != self._last_overrides:
+            self.policy_seq += 1
+            self._last_overrides = overrides
+        return self.frame()
+
+    def frame(self) -> Dict[str, Any]:
+        """The current distribution frame (what set_policy publishes)."""
+        return {
+            "policy_seq": self.policy_seq,
+            "mode": self.mode,
+            "knob_overrides": dict(self._last_overrides),
+            "active_rules": list(self.active),
+        }
+
+
+# ------------------------------------------------------------- controller
+# HealthOpts fields the engine may live-retune on the lighthouse ledger
+# (enforce mode only), keyed by the knob that names them.
+_HEALTH_RETUNE = {
+    "TORCHFT_HEALTH_EJECT_Z": ("eject_z", float),
+    "TORCHFT_HEALTH_WARN_Z": ("warn_z", float),
+    "TORCHFT_HEALTH_EJECT_STEPS": ("eject_steps", int),
+}
+
+
+class PolicyController:
+    """Lighthouse-side glue: drain ring -> engine -> publish frame.
+
+    Constructed with callables (not a native handle) so tests drive it
+    without a live lighthouse; ``coordination.LighthouseServer`` wires the
+    ctypes-bound drain/set_policy/retune functions in. One ``step()`` is
+    one engine pass; the server runs it on a daemon thread every
+    ``TORCHFT_POLICY_INTERVAL_S``."""
+
+    def __init__(
+        self,
+        engine: PolicyEngine,
+        drain_fn: Callable[[], List[Dict[str, Any]]],
+        set_policy_fn: Callable[[Dict[str, Any]], None],
+        retune_health_fn: Optional[Callable[[Dict[str, Any]], Any]] = None,
+    ) -> None:
+        self.engine = engine
+        self._drain = drain_fn
+        self._set_policy = set_policy_fn
+        self._retune = retune_health_fn
+        self._published_seq = -1
+
+    def step(self, now_ms: Optional[int] = None) -> Dict[str, Any]:
+        self.engine.feed(self._drain())
+        frame = self.engine.evaluate(now_ms)
+        if frame["policy_seq"] != self._published_seq:
+            self._set_policy(frame)
+            self._published_seq = frame["policy_seq"]
+            if self.engine.mode == "enforce" and self._retune is not None:
+                partial: Dict[str, Any] = {}
+                for knob, (fld, cast) in _HEALTH_RETUNE.items():
+                    if knob in frame["knob_overrides"]:
+                        partial[fld] = cast(float(frame["knob_overrides"][knob]))
+                if partial:
+                    self._retune(partial)
+        return frame
+
+
+# ---------------------------------------------------------------- scoring
+# Wire-cost factor per compress mode (bytes on the wire relative to fp32).
+_COMPRESS_FACTOR = {"off": 1.0, "fp8": 0.5, "int8": 0.25}
+_DEFAULT_SYNC_EVERY = 32.0
+
+# Component weights for the scalar ranking (lower total = better policy).
+_WEIGHTS = {
+    "discarded_steps": 1.0,
+    "flapping": 10.0,
+    "projected_wire_units": 0.1,
+    "recovery_exposure": 1.0,
+}
+
+
+def score_policy(
+    events: List[Dict[str, Any]],
+    spec: PolicySpec,
+    window_s: float = 300.0,
+    interval_s: float = 5.0,
+) -> Dict[str, Any]:
+    """Replay committed history through a candidate spec and score it.
+
+    The scorer instantiates the SAME :class:`PolicyEngine` the live
+    controller runs and steps it along event time — no second fold, no
+    scorer-only signal math. Components (all lower-is-better):
+
+    - ``discarded_steps``: heal catch-up distance recorded in the run
+      (``to_step - from_step`` summed) — the data's ground-truth cost;
+    - ``flapping``: eject->readmit round-trips in the data plus the
+      engine's own activation flips under this spec (an over-eager spec
+      flaps even on calm history);
+    - ``projected_wire_units``: sync rounds the run would perform under
+      the spec's sync_every/compress decisions, weighted by the codec's
+      wire factor;
+    - ``recovery_exposure``: failures x the sync_every in force when they
+      happened (longer cadence risks more lost local work per failure).
+    """
+    engine = PolicyEngine(spec, mode="observe", window_s=window_s)
+    ordered = sorted(
+        events, key=lambda e: (int(e.get("ts_ms", 0)), int(e.get("seq", 0)))
+    )
+    interval_ms = max(int(interval_s * 1000.0), 1)
+
+    discarded = 0
+    flap_pairs = 0
+    ejected_at: Dict[str, int] = {}
+    wire_units = 0.0
+    exposure = 0.0
+    telemetry_steps = 0
+    # knob state in force between evaluations (engine frame applied)
+    sync_every = _DEFAULT_SYNC_EVERY
+    wire_factor = _COMPRESS_FACTOR["off"]
+
+    next_eval: Optional[int] = None
+    for e in ordered:
+        ts = int(e.get("ts_ms", 0))
+        if next_eval is None:
+            next_eval = ts + interval_ms
+        while ts >= next_eval:
+            frame = engine.evaluate(next_eval)
+            ov = frame["knob_overrides"]
+            sync_every = float(ov.get("TORCHFT_SYNC_EVERY", _DEFAULT_SYNC_EVERY))
+            wire_factor = _COMPRESS_FACTOR.get(
+                ov.get("TORCHFT_COMPRESS", "off"), 1.0
+            )
+            next_eval += interval_ms
+        engine.feed([e])
+        kind = str(e.get("kind", ""))
+        if kind == "heal":
+            discarded += max(
+                int(e.get("to_step", 0)) - int(e.get("from_step", 0)), 0
+            )
+        elif kind == "eject":
+            ejected_at[str(e.get("replica_id", ""))] = ts
+            exposure += sync_every
+        elif kind == "readmit":
+            rid = str(e.get("replica_id", ""))
+            if rid in ejected_at:
+                flap_pairs += 1
+                del ejected_at[rid]
+        elif kind == "telemetry":
+            telemetry_steps += 1
+            # one sync round per sync_every telemetry steps, at the codec's
+            # wire cost — the projection that rewards lengthening under
+            # churn and compressing on flaky links
+            wire_units += wire_factor / max(sync_every, 1.0)
+        elif kind == "quorum":
+            pass
+    final = engine.evaluate(next_eval) if next_eval is not None else engine.frame()
+
+    components = {
+        "discarded_steps": float(discarded),
+        "flapping": float(flap_pairs + engine.flips),
+        "projected_wire_units": round(wire_units, 4),
+        "recovery_exposure": float(exposure),
+    }
+    total = sum(_WEIGHTS[k] * v for k, v in components.items())
+    return {
+        "policy": spec.name,
+        "score": round(total, 4),
+        "components": components,
+        "final_frame": final,
+        "telemetry_steps": telemetry_steps,
+        "signals": engine.signals().to_dict(),
+    }
+
+
+def rank_policies(
+    events: List[Dict[str, Any]],
+    specs: List[PolicySpec],
+    window_s: float = 300.0,
+    interval_s: float = 5.0,
+) -> List[Dict[str, Any]]:
+    """Score every candidate against the same history; best (lowest
+    score) first, name as the deterministic tiebreak."""
+    scored = [
+        score_policy(events, s, window_s=window_s, interval_s=interval_s)
+        for s in specs
+    ]
+    scored.sort(key=lambda r: (r["score"], r["policy"]))
+    return scored
+
+
+# -------------------------------------------------------------------- CLI
+def _usage() -> int:
+    sys.stderr.write(
+        "usage: python -m torchft_tpu.policy replay --history FILE"
+        " --policy SPEC.json|builtin [SPEC.json ...]\n"
+        "       [--window SECONDS] [--interval SECONDS] [--json]\n"
+    )
+    return 2
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] != "replay":
+        return _usage()
+    args = argv[1:]
+    history: Optional[str] = None
+    policies: List[str] = []
+    window_s = 300.0
+    interval_s = 5.0
+    as_json = False
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--history" and i + 1 < len(args):
+            history = args[i + 1]
+            i += 2
+        elif a == "--policy":
+            i += 1
+            while i < len(args) and not args[i].startswith("--"):
+                policies.append(args[i])
+                i += 1
+        elif a == "--window" and i + 1 < len(args):
+            window_s = float(args[i + 1])
+            i += 2
+        elif a == "--interval" and i + 1 < len(args):
+            interval_s = float(args[i + 1])
+            i += 2
+        elif a == "--json":
+            as_json = True
+            i += 1
+        else:
+            return _usage()
+    if history is None or not policies:
+        return _usage()
+
+    from torchft_tpu.tracing import load_history
+
+    events = load_history(history)
+    specs = [PolicySpec.load(p) for p in policies]
+    ranking = rank_policies(
+        events, specs, window_s=window_s, interval_s=interval_s
+    )
+    if as_json:
+        print(json.dumps({"ranking": ranking}, indent=2, sort_keys=True))
+        return 0
+    print(
+        f"replayed {len(events)} events against {len(specs)} candidate"
+        f" polic{'y' if len(specs) == 1 else 'ies'}"
+        f" (window={window_s:g}s interval={interval_s:g}s)"
+    )
+    for rank, r in enumerate(ranking, 1):
+        c = r["components"]
+        print(
+            f"  #{rank} {r['policy']}: score={r['score']:g}"
+            f" discarded={c['discarded_steps']:g}"
+            f" flap={c['flapping']:g}"
+            f" wire={c['projected_wire_units']:g}"
+            f" exposure={c['recovery_exposure']:g}"
+        )
+    best = ranking[0]
+    print(
+        f"winner: {best['policy']} — observe it live (TORCHFT_POLICY="
+        "observe) before enforcing"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -26,7 +26,7 @@ import json
 import sys
 from typing import List
 
-from torchft_tpu.tracing import history_fold, merge_traces, parse_history
+from torchft_tpu.tracing import history_fold, load_history, merge_traces
 
 
 def _usage() -> int:
@@ -62,8 +62,10 @@ def main(argv: List[str]) -> int:
     if cmd == "history":
         if len(args) != 1:
             return _usage()
-        with open(args[0]) as f:
-            events = parse_history(f.read())
+        # load_history sniffs gzip and accepts content too, so this CLI and
+        # coordination.history_replay share one loader (they diverged once:
+        # path-only plain-text here vs content-only there).
+        events = load_history(args[0])
         print(json.dumps(history_fold(events), indent=2, sort_keys=True))
         return 0
     return _usage()

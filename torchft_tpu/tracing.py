@@ -408,6 +408,28 @@ def parse_history(text: str) -> List[Dict[str, Any]]:
     return events
 
 
+def load_history(source: str) -> List[Dict[str, Any]]:
+    """THE history loader: accepts a path to a ``--history`` JSONL file
+    (plain or gzip'd, sniffed by magic bytes — fleets routinely gzip
+    rotated histories) or raw JSONL content, and returns the event list.
+
+    Every consumer funnels through here — the ``trace history`` CLI, the
+    policy replay CLI, and ``coordination.history_replay`` (which keeps
+    its content-only signature but shares this parser) — so path
+    vs. content can never diverge again between entry points.
+    """
+    import gzip
+    import os
+
+    if "\n" not in source and os.path.exists(source):
+        with open(source, "rb") as f:
+            blob = f.read()
+        if blob[:2] == b"\x1f\x8b":
+            blob = gzip.decompress(blob)
+        return parse_history(blob.decode("utf-8"))
+    return parse_history(source)
+
+
 def history_fold(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Canonical fold over history events -> summary.
 
